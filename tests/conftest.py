@@ -1,0 +1,11 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-device
+sharding paths run on any host, mirroring the reference's
+"mpiexec -n N on localhost" testing model (reference tests/README:5-7)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,143 @@
+"""Geometry tests: Cartesian vs Stretched consistency, periodic wrapping,
+coordinate->cell queries (reference tests/geometry analogues)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu.core import ERROR_CELL, Mapping, Topology
+from dccrg_tpu.geometry import (
+    CartesianGeometry,
+    NoGeometry,
+    StretchedCartesianGeometry,
+    geometry_from_id,
+)
+
+
+@pytest.fixture
+def mapping():
+    return Mapping(length=(4, 3, 2), max_refinement_level=2)
+
+
+def test_cartesian_box(mapping):
+    g = CartesianGeometry(
+        mapping=mapping, start=(-1.0, 0.0, 2.0), level_0_cell_length=(0.5, 1.0, 2.0)
+    )
+    np.testing.assert_allclose(g.get_start(), [-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(g.get_end(), [-1.0 + 4 * 0.5, 3.0, 2.0 + 2 * 2.0])
+
+
+def test_cartesian_center_length(mapping):
+    g = CartesianGeometry(mapping=mapping, level_0_cell_length=(1.0, 1.0, 1.0))
+    cells = np.arange(1, int(mapping.last_cell) + 1, dtype=np.uint64)
+    lvl = mapping.get_refinement_level(cells)
+    lens = g.get_length(cells)
+    np.testing.assert_allclose(lens, (1.0 / 2**lvl)[:, None] * np.ones(3))
+    centers = g.get_center(cells)
+    mins, maxs = g.get_min(cells), g.get_max(cells)
+    np.testing.assert_allclose(centers, 0.5 * (mins + maxs))
+    # cell 1 is the level-0 cell at origin corner
+    np.testing.assert_allclose(g.get_center(np.uint64(1)), [0.5, 0.5, 0.5])
+
+    # invalid -> NaN
+    assert np.isnan(g.get_center(np.uint64(0))).all()
+
+
+def test_coord_to_cell_roundtrip(mapping):
+    g = CartesianGeometry(mapping=mapping, start=(0.5, -2.0, 0.0),
+                          level_0_cell_length=(2.0, 0.25, 1.5))
+    cells = np.arange(1, int(mapping.last_cell) + 1, dtype=np.uint64)
+    lvl = mapping.get_refinement_level(cells)
+    centers = g.get_center(cells)
+    got = np.empty_like(cells)
+    for i, (c, l) in enumerate(zip(centers, lvl)):
+        got[i] = g.get_cell(int(l), c)
+    np.testing.assert_array_equal(got, cells)
+
+
+def test_periodic_wrapping():
+    m = Mapping(length=(4, 4, 4))
+    g = CartesianGeometry(
+        mapping=m, topology=Topology(periodic=(True, False, False)),
+        level_0_cell_length=(1.0, 1.0, 1.0),
+    )
+    r = g.get_real_coordinate(np.array([-0.5, -0.5, 2.0]))
+    assert r[0] == pytest.approx(3.5)
+    assert np.isnan(r[1])
+    assert r[2] == 2.0
+    # wrapped coordinate lands in the right cell
+    assert int(g.get_cell(0, np.array([4.5, 1.0, 1.0]))) == int(
+        g.get_cell(0, np.array([0.5, 1.0, 1.0]))
+    )
+    # outside non-periodic -> ERROR_CELL
+    assert int(g.get_cell(0, np.array([1.0, 9.0, 1.0]))) == int(ERROR_CELL)
+
+
+def test_stretched_matches_cartesian_when_uniform(mapping):
+    uniform = StretchedCartesianGeometry(
+        mapping=mapping,
+        coordinates=(
+            np.arange(5) * 2.0 + 1.0,
+            np.arange(4) * 0.5,
+            np.arange(3) * 1.0,
+        ),
+    )
+    cart = CartesianGeometry(
+        mapping=mapping, start=(1.0, 0.0, 0.0), level_0_cell_length=(2.0, 0.5, 1.0)
+    )
+    cells = np.arange(1, int(mapping.last_cell) + 1, dtype=np.uint64)
+    np.testing.assert_allclose(uniform.get_center(cells), cart.get_center(cells))
+    np.testing.assert_allclose(uniform.get_length(cells), cart.get_length(cells))
+    np.testing.assert_allclose(uniform.get_min(cells), cart.get_min(cells))
+    coords = cart.get_center(cells)
+    lvls = mapping.get_refinement_level(cells)
+    for c, l, cell in zip(coords[:50], lvls[:50], cells[:50]):
+        assert int(uniform.get_cell(int(l), c)) == int(cell)
+
+
+def test_stretched_nonuniform():
+    m = Mapping(length=(3, 1, 1), max_refinement_level=1)
+    g = StretchedCartesianGeometry(
+        mapping=m,
+        coordinates=(np.array([0.0, 1.0, 10.0, 100.0]), np.array([0.0, 1.0]),
+                     np.array([0.0, 1.0])),
+    )
+    # level-0 cells have widths 1, 9, 90
+    lvl0 = np.array([1, 2, 3], dtype=np.uint64)
+    np.testing.assert_allclose(g.get_length(lvl0)[:, 0], [1.0, 9.0, 90.0])
+    # children split the parent in half in physical space
+    ch = m.get_all_children(np.uint64(2))
+    np.testing.assert_allclose(g.get_min(ch[:1])[0, 0], 1.0)
+    np.testing.assert_allclose(g.get_length(ch)[:, 0], 4.5)
+    # coordinate lookup
+    assert int(g.get_cell(0, np.array([50.0, 0.5, 0.5]))) == 3
+    assert int(g.get_cell(1, np.array([3.0, 0.2, 0.2]))) == int(ch[0])
+
+
+def test_no_geometry(mapping):
+    g = NoGeometry(mapping)
+    np.testing.assert_allclose(g.get_start(), [0, 0, 0])
+    np.testing.assert_allclose(g.get_end(), [4, 3, 2])
+    assert g.geometry_id == 0
+
+
+def test_geometry_file_roundtrip(mapping):
+    top = Topology(periodic=(True, True, False))
+    g = CartesianGeometry(
+        mapping=mapping, topology=top, start=(1.0, 2.0, 3.0),
+        level_0_cell_length=(0.1, 0.2, 0.3),
+    )
+    cls = geometry_from_id(g.geometry_id)
+    g2, n = cls.params_from_file_bytes(g.params_to_file_bytes(), mapping, top)
+    assert n == 48
+    np.testing.assert_allclose(g2.get_start(), g.get_start())
+    np.testing.assert_allclose(g2.get_end(), g.get_end())
+
+    s = StretchedCartesianGeometry(
+        mapping=mapping,
+        coordinates=(np.array([0.0, 1, 2, 4, 8.0]), np.array([0.0, 1, 3, 6.0]),
+                     np.array([0.0, 2, 5.0])),
+    )
+    s2, _ = StretchedCartesianGeometry.params_from_file_bytes(
+        s.params_to_file_bytes(), mapping, top
+    )
+    for a, b in zip(s2.coordinates, s.coordinates):
+        np.testing.assert_allclose(a, b)

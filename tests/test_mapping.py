@@ -1,0 +1,206 @@
+"""Property tests for the cell-id algebra against a brute-force oracle.
+
+The oracle below re-derives the reference's id scheme from its definition
+(level blocks, x-fastest ordering — reference dccrg_mapping.hpp:153-289)
+with plain Python ints, and the vectorized implementation must agree on
+every valid id of several small grids (and on invalid inputs' sentinels).
+"""
+import numpy as np
+import pytest
+
+from dccrg_tpu.core import ERROR_CELL, ERROR_INDEX, Mapping
+
+
+def oracle_level_offset(length, lvl):
+    n = length[0] * length[1] * length[2]
+    return 1 + sum(n * 8**i for i in range(lvl))
+
+
+def oracle_refinement_level(length, max_ref, cell):
+    if cell == 0:
+        return -1
+    last = 0
+    for lvl in range(max_ref + 1):
+        last += length[0] * length[1] * length[2] * 8**lvl
+        if cell <= last:
+            return lvl
+    return -1
+
+
+def oracle_indices(length, max_ref, cell):
+    lvl = oracle_refinement_level(length, max_ref, cell)
+    if lvl < 0:
+        return (int(ERROR_INDEX),) * 3
+    local = cell - oracle_level_offset(length, lvl)
+    lx = length[0] * 2**lvl
+    ly = length[1] * 2**lvl
+    scale = 2 ** (max_ref - lvl)
+    return (
+        (local % lx) * scale,
+        ((local // lx) % ly) * scale,
+        (local // (lx * ly)) * scale,
+    )
+
+
+def oracle_cell_from_indices(length, max_ref, ind, lvl):
+    nx = length[0] * 2**max_ref
+    ny = length[1] * 2**max_ref
+    nz = length[2] * 2**max_ref
+    if not (0 <= ind[0] < nx and 0 <= ind[1] < ny and 0 <= ind[2] < nz):
+        return 0
+    if not (0 <= lvl <= max_ref):
+        return 0
+    scale = 2 ** (max_ref - lvl)
+    ix, iy, iz = ind[0] // scale, ind[1] // scale, ind[2] // scale
+    lx = length[0] * 2**lvl
+    ly = length[1] * 2**lvl
+    return oracle_level_offset(length, lvl) + ix + iy * lx + iz * lx * ly
+
+
+GRIDS = [
+    ((1, 1, 1), 0),
+    ((1, 1, 1), 2),
+    ((3, 2, 1), 1),
+    ((2, 3, 4), 2),
+    ((5, 1, 7), 1),
+]
+
+
+@pytest.mark.parametrize("length,max_ref", GRIDS)
+def test_roundtrip_all_cells(length, max_ref):
+    m = Mapping(length=length, max_refinement_level=max_ref)
+    n_total = sum(
+        length[0] * length[1] * length[2] * 8**l for l in range(max_ref + 1)
+    )
+    assert int(m.last_cell) == n_total
+
+    cells = np.arange(1, n_total + 1, dtype=np.uint64)
+    lvls = m.get_refinement_level(cells)
+    inds = m.get_indices(cells)
+    back = m.get_cell_from_indices(inds, lvls)
+    np.testing.assert_array_equal(back, cells)
+
+    # spot-check levels and indices against the oracle
+    rng = np.random.default_rng(42)
+    sample = rng.choice(n_total, size=min(200, n_total), replace=False)
+    for s in sample:
+        cell = int(cells[s])
+        assert int(lvls[s]) == oracle_refinement_level(length, max_ref, cell)
+        assert tuple(int(v) for v in inds[s]) == oracle_indices(length, max_ref, cell)
+
+
+@pytest.mark.parametrize("length,max_ref", GRIDS)
+def test_cell_from_indices_matches_oracle(length, max_ref):
+    m = Mapping(length=length, max_refinement_level=max_ref)
+    rng = np.random.default_rng(7)
+    nx, ny, nz = m.length_in_indices
+    for _ in range(100):
+        ind = (rng.integers(0, nx), rng.integers(0, ny), rng.integers(0, nz))
+        lvl = int(rng.integers(0, max_ref + 1))
+        got = m.get_cell_from_indices(np.array(ind, dtype=np.uint64), lvl)
+        assert int(got) == oracle_cell_from_indices(length, max_ref, ind, lvl)
+
+
+def test_invalid_inputs_yield_sentinels():
+    m = Mapping(length=(2, 2, 2), max_refinement_level=1)
+    last = int(m.last_cell)
+    bad = np.array([0, last + 1, last + 100], dtype=np.uint64)
+    assert (m.get_refinement_level(bad) == -1).all()
+    assert (m.get_indices(bad) == ERROR_INDEX).all()
+    assert (m.get_parent(bad) == ERROR_CELL).all()
+    # out-of-range indices
+    nx, ny, nz = m.length_in_indices
+    assert int(m.get_cell_from_indices(np.array([nx, 0, 0], dtype=np.uint64), 0)) == 0
+    # bad level
+    assert int(m.get_cell_from_indices(np.array([0, 0, 0], dtype=np.uint64), 2)) == 0
+
+
+def test_parent_child_relations():
+    m = Mapping(length=(2, 2, 2), max_refinement_level=2)
+    cells = np.arange(1, int(m.last_cell) + 1, dtype=np.uint64)
+    lvls = m.get_refinement_level(cells)
+
+    # level-0 cells are their own parent
+    lvl0 = cells[lvls == 0]
+    np.testing.assert_array_equal(m.get_parent(lvl0), lvl0)
+
+    # children of non-max cells: 8 distinct, one level finer, parent maps back
+    refinable = cells[lvls < m.max_refinement_level]
+    ch = m.get_all_children(refinable)
+    assert ch.shape == (len(refinable), 8)
+    assert (ch != ERROR_CELL).all()
+    assert (m.get_refinement_level(ch) == (m.get_refinement_level(refinable)[:, None] + 1)).all()
+    parents = m.get_parent(ch)
+    np.testing.assert_array_equal(parents, np.broadcast_to(refinable[:, None], ch.shape))
+    # children distinct within a family
+    assert all(len(set(row.tolist())) == 8 for row in ch)
+
+    # max-level cells have no children
+    at_max = cells[lvls == m.max_refinement_level]
+    assert (m.get_all_children(at_max) == ERROR_CELL).all()
+
+    # get_child = first child; at max level returns the cell itself
+    first = m.get_child(refinable)
+    np.testing.assert_array_equal(first, ch[:, 0])
+    np.testing.assert_array_equal(m.get_child(at_max), at_max)
+
+    # siblings: all children of parent, cell is a member
+    finer = cells[lvls > 0]
+    sib = m.get_siblings(finer)
+    assert ((sib == finer[:, None]).sum(axis=1) == 1).all()
+
+    # level-0 siblings: just the cell
+    sib0 = m.get_siblings(lvl0)
+    np.testing.assert_array_equal(sib0[:, 0], lvl0)
+    assert (sib0[:, 1:] == ERROR_CELL).all()
+
+    # level-0 parent
+    np.testing.assert_array_equal(
+        m.get_refinement_level(m.get_level_0_parent(cells)),
+        np.zeros(len(cells), dtype=np.int64),
+    )
+
+
+def test_cell_length_in_indices():
+    m = Mapping(length=(2, 1, 1), max_refinement_level=2)
+    cells = np.arange(1, int(m.last_cell) + 1, dtype=np.uint64)
+    lvls = m.get_refinement_level(cells)
+    lens = m.get_cell_length_in_indices(cells)
+    np.testing.assert_array_equal(lens, (1 << (2 - lvls)).astype(np.uint64))
+
+
+def test_scalar_inputs():
+    m = Mapping(length=(2, 2, 2), max_refinement_level=1)
+    assert int(m.get_refinement_level(np.uint64(1))) == 0
+    assert int(m.get_parent(np.uint64(9))) != 0
+    assert m.get_all_children(np.uint64(1)).shape == (8,)
+    assert m.get_siblings(np.uint64(1)).shape == (8,)
+
+
+def test_file_roundtrip():
+    m = Mapping(length=(3, 4, 5), max_refinement_level=2)
+    data = m.to_file_bytes()
+    assert len(data) == Mapping.FILE_DATA_SIZE
+    m2 = Mapping.from_file_bytes(data)
+    assert m2 == m
+
+
+def test_max_possible_refinement_level():
+    # 1x1x1 grid: sum_{l<=21} 8^l = (8^22-1)/7 ~ 1.05e19 fits in uint64,
+    # sum_{l<=22} does not -> max possible level is 21 (as in the reference)
+    m = Mapping(length=(1, 1, 1))
+    assert m.max_possible_refinement_level() == 21
+    with pytest.raises(ValueError):
+        Mapping(length=(1, 1, 1), max_refinement_level=22)
+    # larger grid shrinks the budget
+    m2 = Mapping(length=(1000, 1000, 1000))
+    assert m2.max_possible_refinement_level() < 12
+
+
+def test_topology_roundtrip():
+    from dccrg_tpu.core import Topology
+
+    t = Topology(periodic=(True, False, True))
+    assert t.is_periodic(0) and not t.is_periodic(1) and t.is_periodic(2)
+    t2 = Topology.from_file_bytes(t.to_file_bytes())
+    assert t2 == t
