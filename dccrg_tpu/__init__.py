@@ -1,0 +1,27 @@
+"""dccrg_tpu: a TPU-native distributed Cartesian cell-refinable grid.
+
+A from-scratch re-design of the capabilities of dccrg (the header-only
+C++/MPI library under Vlasiator) for JAX/XLA on TPU meshes: sharded SoA cell
+payloads in HBM, halo exchanges as XLA collectives over ICI, host-side
+replicated grid/AMR metadata, and native load balancing in place of Zoltan.
+"""
+from .core.mapping import ERROR_CELL, ERROR_INDEX, Mapping
+from .core.topology import Topology
+from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
+from .grid import CellSpec, Grid
+from .parallel.mesh import make_mesh
+
+__all__ = [
+    "ERROR_CELL",
+    "ERROR_INDEX",
+    "Mapping",
+    "Topology",
+    "CartesianGeometry",
+    "NoGeometry",
+    "StretchedCartesianGeometry",
+    "CellSpec",
+    "Grid",
+    "make_mesh",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from .partition import block_partition, morton_partition
+from .mesh import make_mesh
+
+__all__ = ["block_partition", "morton_partition", "make_mesh"]

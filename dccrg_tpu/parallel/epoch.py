@@ -1,0 +1,291 @@
+"""Partition epoch: every piece of derived distributed state.
+
+The reference rebuilds derived structures (neighbor lists, remote-neighbor
+info, send/recv lists, ghost allocations, iterator caches) after every
+mutating collective (``dccrg.hpp`` §3.4/3.5 tails).  Here all of that is one
+immutable ``Epoch`` object, rebuilt from ``(leaves, neighborhoods)`` after
+``balance_load``/``stop_refining`` — and every jitted schedule is keyed by
+the epoch so XLA never recompiles mid-run.
+
+Row layout per device: rows ``[0, n_local)`` hold the device's own cells in
+ascending id order; rows ``[n_local, n_local + n_ghost)`` hold ghost copies
+of remote neighbors in ascending id order; row ``R - 1`` is a scratch row
+that absorbs padded gathers/scatters.  ``R`` is uniform across devices so
+payloads live as dense ``[D, R, ...]`` arrays sharded over the mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.mapping import Mapping
+from ..core.topology import Topology
+from ..core.neighbors import LeafSet, NeighborLists, find_all_neighbors, invert_neighbors
+
+__all__ = ["HoodState", "Epoch", "build_epoch"]
+
+
+@dataclass
+class HoodState:
+    """Per-neighborhood derived state (the default neighborhood and each
+    user-added one get their own — reference ``dccrg.hpp:6383-6603``)."""
+
+    offsets: np.ndarray            # (K, 3) neighborhood offsets
+    lists: NeighborLists           # neighbors-of over ALL leaves
+    to_start: np.ndarray           # inverse CSR (neighbors-to) over all leaves
+    to_src: np.ndarray
+    # per-device send/recv schedule, aligned pairwise:
+    # send_rows[i, j, :] = local rows on i shipped to j (pad = scratch)
+    send_rows: np.ndarray          # (D, D, S) int32
+    recv_rows: np.ndarray          # (D, D, S) int32: recv_rows[j, i] ghost rows on j from i
+    pair_counts: np.ndarray        # (D, D) int64 cells exchanged per pair
+    inner_mask: np.ndarray         # (D, R) bool: local cell, no remote neighbor
+    outer_mask: np.ndarray         # (D, R) bool: local cell with remote neighbor
+    # neighbor gather tables over local rows:
+    nbr_rows: np.ndarray           # (D, R, Kmax) int32 row indices (pad = scratch)
+    nbr_valid: np.ndarray          # (D, R, Kmax) bool
+    nbr_offset: np.ndarray         # (D, R, Kmax, 3) int32 offsets in index units
+    nbr_len: np.ndarray            # (D, R, Kmax) int32 neighbor edge length in index units
+    nbr_slot: np.ndarray           # (D, R, Kmax) int32 neighborhood-offset index
+
+
+@dataclass
+class Epoch:
+    mapping: Mapping
+    topology: Topology
+    leaves: LeafSet
+    n_devices: int
+    R: int                         # rows per device incl. ghosts + 1 scratch
+    n_local: np.ndarray            # (D,) local cell counts
+    n_ghost: np.ndarray            # (D,) ghost counts
+    local_pos: list                # per device: (n_local,) global leaf positions
+    ghost_pos: list                # per device: (n_ghost,) global leaf positions
+    row_of: np.ndarray             # (N,) int32 local row of each leaf on its owner
+    cell_len: np.ndarray           # (D, R) int32 cell edge length in index units (0 pad)
+    cell_level: np.ndarray         # (D, R) int8 refinement level (-1 pad)
+    cell_ids: np.ndarray           # (D, R) uint64 cell id per row (0 pad)
+    local_mask: np.ndarray         # (D, R) bool
+    hoods: dict = field(default_factory=dict)   # hood id (None = default) -> HoodState
+
+    # ------------------------------------------------------------- lookups
+
+    def rows_on_device(self, d: int, pos: np.ndarray) -> np.ndarray:
+        """Row on device d for each global leaf position (local or ghost);
+        scratch row for positions not present on d."""
+        pos = np.asarray(pos, dtype=np.int64)
+        out = np.full(len(pos), self.R - 1, dtype=np.int64)
+        lp, gp = self.local_pos[d], self.ghost_pos[d]
+        if len(lp):
+            li_c = np.minimum(np.searchsorted(lp, pos), len(lp) - 1)
+            m = lp[li_c] == pos
+            out[m] = li_c[m]
+        if len(gp):
+            gi = np.searchsorted(gp, pos)
+            gi_c = np.minimum(gi, len(gp) - 1)
+            m = gp[gi_c] == pos
+            out[m] = self.n_local[d] + gi_c[m]
+        return out
+
+    def global_rows(self, pos: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(device, row) of each leaf position on its owning device."""
+        pos = np.asarray(pos, dtype=np.int64)
+        return self.leaves.owner[pos], self.row_of[pos]
+
+
+def _build_hood(
+    mapping: Mapping,
+    topology: Topology,
+    leaves: LeafSet,
+    offsets: np.ndarray,
+):
+    N = len(leaves)
+    lists = find_all_neighbors(mapping, topology, leaves, offsets)
+    to_start, to_src = invert_neighbors(N, lists)
+    owner = leaves.owner.astype(np.int64)
+
+    # --- ghost requirement: remote cells in neighbors_of/to of local cells
+    src_of = np.repeat(np.arange(N), np.diff(lists.start))
+    # (device needing, remote pos) from neighbors_of
+    mask = owner[src_of] != owner[lists.nbr_pos]
+    of_pairs = np.stack([owner[src_of][mask], lists.nbr_pos[mask]], axis=1)
+    # from neighbors_to
+    src_to = np.repeat(np.arange(N), np.diff(to_start))
+    mask_t = owner[src_to] != owner[to_src]
+    to_pairs = np.stack([owner[src_to][mask_t], to_src[mask_t]], axis=1)
+    pairs = np.unique(np.concatenate([of_pairs, to_pairs], axis=0), axis=0)
+    return lists, to_start, to_src, pairs
+
+
+def build_epoch(
+    mapping: Mapping,
+    topology: Topology,
+    leaves: LeafSet,
+    n_devices: int,
+    neighborhoods: dict,
+) -> Epoch:
+    """Build the complete derived state for a (leaves, owner) snapshot.
+
+    ``neighborhoods``: dict hood-id -> (K,3) offsets; must contain the
+    default hood under key ``None``.
+    """
+    N = len(leaves)
+    D = n_devices
+    owner = leaves.owner.astype(np.int64)
+
+    # --- pass 1: neighbor lists + ghost requirements per hood
+    hood_raw = {}
+    all_pairs = []
+    for hid, offsets in neighborhoods.items():
+        lists, to_start, to_src, pairs = _build_hood(mapping, topology, leaves, offsets)
+        hood_raw[hid] = (offsets, lists, to_start, to_src, pairs)
+        all_pairs.append(pairs)
+    pairs = (
+        np.unique(np.concatenate(all_pairs, axis=0), axis=0)
+        if all_pairs
+        else np.zeros((0, 2), dtype=np.int64)
+    )
+
+    # --- row layout
+    local_pos = [np.flatnonzero(owner == d) for d in range(D)]
+    ghost_pos = [np.sort(pairs[pairs[:, 0] == d, 1]) for d in range(D)]
+    n_local = np.array([len(p) for p in local_pos], dtype=np.int64)
+    n_ghost = np.array([len(p) for p in ghost_pos], dtype=np.int64)
+    R = int((n_local + n_ghost).max()) + 1 if N else 1
+
+    row_of = np.zeros(N, dtype=np.int64)
+    for d in range(D):
+        row_of[local_pos[d]] = np.arange(n_local[d])
+
+    cell_len = np.zeros((D, R), dtype=np.int32)
+    cell_level = np.full((D, R), -1, dtype=np.int8)
+    cell_ids = np.zeros((D, R), dtype=np.uint64)
+    local_mask = np.zeros((D, R), dtype=bool)
+    lvl_all = mapping.get_refinement_level(leaves.cells)
+    len_all = mapping.get_cell_length_in_indices(leaves.cells).astype(np.int64)
+    for d in range(D):
+        rows_l = np.arange(n_local[d])
+        rows_g = n_local[d] + np.arange(n_ghost[d])
+        for rows, pos in ((rows_l, local_pos[d]), (rows_g, ghost_pos[d])):
+            cell_len[d, rows] = len_all[pos]
+            cell_level[d, rows] = lvl_all[pos]
+            cell_ids[d, rows] = leaves.cells[pos]
+        local_mask[d, rows_l] = True
+
+    epoch = Epoch(
+        mapping=mapping,
+        topology=topology,
+        leaves=leaves,
+        n_devices=D,
+        R=R,
+        n_local=n_local,
+        n_ghost=n_ghost,
+        local_pos=local_pos,
+        ghost_pos=ghost_pos,
+        row_of=row_of,
+        cell_len=cell_len,
+        cell_level=cell_level,
+        cell_ids=cell_ids,
+        local_mask=local_mask,
+    )
+
+    # --- pass 2: per-hood device tables + schedules
+    for hid, (offsets, lists, to_start, to_src, h_pairs) in hood_raw.items():
+        epoch.hoods[hid] = _finish_hood(
+            epoch, offsets, lists, to_start, to_src, h_pairs, len_all
+        )
+    return epoch
+
+
+def _finish_hood(
+    epoch: Epoch,
+    offsets: np.ndarray,
+    lists: NeighborLists,
+    to_start: np.ndarray,
+    to_src: np.ndarray,
+    pairs: np.ndarray,
+    len_all: np.ndarray,
+) -> HoodState:
+    D, R, N = epoch.n_devices, epoch.R, len(epoch.leaves)
+    owner = epoch.leaves.owner.astype(np.int64)
+    scratch = R - 1
+
+    # --- halo schedule: for each (receiver j, sender i) the cells are the
+    # hood's ghost pairs; order by cell id (= by position) like the
+    # reference's sorted send/recv lists (dccrg.hpp:8590-8752)
+    pair_counts = np.zeros((D, D), dtype=np.int64)
+    cell_sets = {}
+    for j in range(D):
+        p = pairs[pairs[:, 0] == j, 1]
+        if len(p) == 0:
+            continue
+        senders = owner[p]
+        for i in np.unique(senders):
+            cp = np.sort(p[senders == i])
+            cell_sets[(i, j)] = cp
+            pair_counts[i, j] = len(cp)
+    S = int(pair_counts.max()) if pair_counts.size else 0
+    S = max(S, 1)
+    send_rows = np.full((D, D, S), scratch, dtype=np.int32)
+    recv_rows = np.full((D, D, S), scratch, dtype=np.int32)
+    for (i, j), cp in cell_sets.items():
+        send_rows[i, j, : len(cp)] = epoch.row_of[cp]
+        recv_rows[j, i, : len(cp)] = epoch.rows_on_device(j, cp)
+
+    # --- neighbor gather tables over local rows
+    counts = np.diff(lists.start)
+    Kmax = int(counts.max()) if N else 1
+    Kmax = max(Kmax, 1)
+    nbr_rows = np.full((D, R, Kmax), scratch, dtype=np.int32)
+    nbr_valid = np.zeros((D, R, Kmax), dtype=bool)
+    nbr_offset = np.zeros((D, R, Kmax, 3), dtype=np.int32)
+    nbr_len = np.zeros((D, R, Kmax), dtype=np.int32)
+    nbr_slot = np.zeros((D, R, Kmax), dtype=np.int32)
+    ecol = np.concatenate([np.arange(c) for c in counts]) if N else np.zeros(0, int)
+    esrc = np.repeat(np.arange(N), counts)
+    for d in range(D):
+        sel = owner[esrc] == d
+        if not sel.any():
+            continue
+        rows = epoch.row_of[esrc[sel]]
+        cols = ecol[sel]
+        nrows = epoch.rows_on_device(d, lists.nbr_pos[sel])
+        nbr_rows[d, rows, cols] = nrows
+        nbr_valid[d, rows, cols] = True
+        nbr_offset[d, rows, cols] = lists.offset[sel]
+        nbr_len[d, rows, cols] = len_all[lists.nbr_pos[sel]]
+        nbr_slot[d, rows, cols] = lists.slot[sel]
+
+    # --- inner/outer split (dccrg.hpp:7478-7519): outer = local cell with a
+    # remote cell among neighbors_of or neighbors_to
+    src_of = np.repeat(np.arange(N), counts)
+    remote_of = owner[src_of] != owner[lists.nbr_pos]
+    src_to = np.repeat(np.arange(N), np.diff(to_start))
+    remote_to = owner[src_to] != owner[to_src]
+    is_outer = np.zeros(N, dtype=bool)
+    np.logical_or.at(is_outer, src_of[remote_of], True)
+    np.logical_or.at(is_outer, src_to[remote_to], True)
+    inner_mask = np.zeros((D, R), dtype=bool)
+    outer_mask = np.zeros((D, R), dtype=bool)
+    for d in range(D):
+        lp = epoch.local_pos[d]
+        rows = np.arange(len(lp))
+        inner_mask[d, rows] = ~is_outer[lp]
+        outer_mask[d, rows] = is_outer[lp]
+
+    return HoodState(
+        offsets=offsets,
+        lists=lists,
+        to_start=to_start,
+        to_src=to_src,
+        send_rows=send_rows,
+        recv_rows=recv_rows,
+        pair_counts=pair_counts,
+        inner_mask=inner_mask,
+        outer_mask=outer_mask,
+        nbr_rows=nbr_rows,
+        nbr_valid=nbr_valid,
+        nbr_offset=nbr_offset,
+        nbr_len=nbr_len,
+        nbr_slot=nbr_slot,
+    )

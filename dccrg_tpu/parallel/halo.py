@@ -1,0 +1,90 @@
+"""Halo-exchange engine: ghost-cell updates as one XLA collective.
+
+TPU-native replacement for the reference's per-rank-pair
+``MPI_Type_create_struct`` + ``Isend/Irecv`` engine
+(``dccrg.hpp:10564-11070``): the send/recv lists become device index arrays
+(built in ``epoch.py`` from the same list computation as
+``recalculate_neighbor_update_send_receive_lists``, ``dccrg.hpp:8590-8889``)
+and the transfer lowers to gather -> ``lax.all_to_all`` over the mesh ->
+scatter, all inside one ``shard_map`` so XLA rides ICI and can overlap the
+collective with unrelated compute (the reference's split-phase pattern,
+``dccrg.hpp:4997-5367``).
+
+Ghost copies are bit-identical to the source rows: the schedule moves raw
+array values with no arithmetic.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import SHARD_AXIS
+
+__all__ = ["HaloExchange"]
+
+
+class HaloExchange:
+    """Compiled halo-exchange schedule for one (epoch, neighborhood).
+
+    ``exchange(state)`` returns the state with ghost rows refreshed from
+    their owners; ``state`` is a pytree of ``[D, R, ...]`` arrays sharded on
+    the leading axis.
+    """
+
+    def __init__(self, epoch, hood, mesh):
+        self.mesh = mesh
+        self.D = epoch.n_devices
+        self.R = epoch.R
+        spec3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        self.send_rows = jax.device_put(jnp.asarray(hood.send_rows), spec3)
+        self.recv_rows = jax.device_put(jnp.asarray(hood.recv_rows), spec3)
+        #: cells moved per exchange (for bandwidth accounting)
+        self.cells_moved = int(hood.pair_counts.sum())
+        self._fn = self._build()
+
+    def _build(self):
+        mesh = self.mesh
+        data_spec = P(SHARD_AXIS)
+        idx_spec = P(SHARD_AXIS, None, None)
+
+        def body(send_rows, recv_rows, state):
+            # block shapes: send_rows/recv_rows [1, D, S]; leaves [1, R, ...]
+            sr = send_rows[0]                     # [D, S]
+            rr = recv_rows[0]                     # [D, S]
+
+            def exchange_leaf(x):
+                blk = x[0]                        # [R, ...]
+                buf = blk[sr]                     # [D, S, ...] rows to send
+                recvd = jax.lax.all_to_all(
+                    buf, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+                )                                 # [D, S, ...] from each source
+                flat_rows = rr.reshape(-1)
+                flat_vals = recvd.reshape((-1,) + recvd.shape[2:])
+                return blk.at[flat_rows].set(flat_vals)[None]
+
+            return jax.tree_util.tree_map(exchange_leaf, state)
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(idx_spec, idx_spec, data_spec),
+            out_specs=data_spec,
+            check_vma=False,
+        )
+        return jax.jit(lambda state: fn(self.send_rows, self.recv_rows, state))
+
+    def __call__(self, state):
+        return self._fn(state)
+
+    def bytes_moved(self, state) -> int:
+        """Total payload bytes crossing the mesh per exchange."""
+        per_cell = sum(
+            int(np.prod(x.shape[2:])) * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(state)
+        )
+        return self.cells_moved * per_cell

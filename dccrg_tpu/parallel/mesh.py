@@ -1,0 +1,30 @@
+"""Device-mesh helpers.
+
+The framework shards cell payloads over a 1-D ``jax.sharding.Mesh`` axis
+named ``"shard"`` — the analogue of the reference's MPI rank space
+(``dccrg.hpp:7622-7687``).  Hierarchical (ICI vs DCN) layouts reshape the
+same axis; see ``parallel/partition.py`` for hierarchical partitioning.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "shard_spec", "SHARD_AXIS"]
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices=None, n_devices: int | None = None) -> Mesh:
+    """1-D mesh over given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_spec(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding that splits the leading (device) axis of a [D, ...] array."""
+    return NamedSharding(mesh, P(SHARD_AXIS, *([None] * (ndim - 1))))

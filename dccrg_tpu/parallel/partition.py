@@ -1,0 +1,66 @@
+"""Cell-to-device partitioning.
+
+Plays the role of the reference's initial striping
+(``create_level_0_cells``, ``dccrg.hpp:7967-8102``) and of Zoltan's
+repartitioners (``dccrg.hpp:8349-8581``): a partition is just an int32
+owner-device array aligned with the sorted leaf-cell array.  Weighted
+variants balance user per-cell weights (``dccrg.hpp:6210-6276``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["block_partition", "morton_partition", "weighted_blocks"]
+
+
+def weighted_blocks(order: np.ndarray, weights: np.ndarray | None, n_parts: int) -> np.ndarray:
+    """Assign cells (in the given traversal order) to ``n_parts`` contiguous
+    blocks of near-equal total weight.  Returns owner per cell (original
+    order)."""
+    n = len(order)
+    owner = np.empty(n, dtype=np.int32)
+    if weights is None:
+        # equal-count striping like the reference's block assignment
+        counts = np.full(n_parts, n // n_parts, dtype=np.int64)
+        counts[: n % n_parts] += 1
+        bounds = np.concatenate([[0], np.cumsum(counts)])
+        for p in range(n_parts):
+            owner[order[bounds[p] : bounds[p + 1]]] = p
+        return owner
+    w = np.maximum(np.asarray(weights, dtype=np.float64)[order], 0.0)
+    cum = np.cumsum(w)
+    total = cum[-1] if len(cum) else 0.0
+    if total <= 0:
+        return weighted_blocks(order, None, n_parts)
+    # part p gets cells whose cumulative weight falls in (p/n, (p+1)/n]
+    part = np.minimum((cum - w / 2) / total * n_parts, n_parts - 1).astype(np.int32)
+    owner[order] = part
+    return owner
+
+
+def block_partition(cells: np.ndarray, n_parts: int, weights=None) -> np.ndarray:
+    """Contiguous id-order striping (the reference's default initial
+    assignment)."""
+    return weighted_blocks(np.arange(len(cells)), weights, n_parts)
+
+
+def _morton_key(indices: np.ndarray) -> np.ndarray:
+    """Interleave bits of 3-D indices into a Morton (Z-order) key."""
+    idx = indices.astype(np.uint64)
+    key = np.zeros(len(idx), dtype=np.uint64)
+    nbits = int(max(1, np.ceil(np.log2(float(idx.max()) + 1)))) if len(idx) else 1
+    for b in range(min(nbits, 21)):
+        for d in range(3):
+            key |= ((idx[:, d] >> np.uint64(b)) & np.uint64(1)) << np.uint64(3 * b + d)
+    return key
+
+
+def morton_partition(mapping, cells: np.ndarray, n_parts: int, weights=None) -> np.ndarray:
+    """Space-filling-curve striping: order leaves along a Morton curve of
+    their (center-ish) indices then cut into weight-balanced blocks — the
+    role of the reference's optional Hilbert-SFC initial partition
+    (``dccrg.hpp:56-58``, USE_SFC) and Zoltan's HSFC method."""
+    ind = mapping.get_indices(cells)
+    keys = _morton_key(ind)
+    order = np.argsort(keys, kind="stable")
+    return weighted_blocks(order, weights, n_parts)

@@ -1,0 +1,159 @@
+"""Neighbor-engine tests against a brute-force geometric oracle
+(reference analogues: tests/get_neighbors_, tests/get_face_neighbors)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu.core import Mapping, Topology
+from dccrg_tpu.core.neighborhood import default_neighborhood
+from dccrg_tpu.core.neighbors import LeafSet, find_all_neighbors, invert_neighbors
+
+
+def oracle_neighbors(mapping, topology, leaves, hood, cell):
+    """Brute force: for each slot, scan all leaves for coverage of the slot
+    region, mirroring find_neighbors_of semantics."""
+    lvl = int(mapping.get_refinement_level(np.uint64(cell)))
+    idx = mapping.get_indices(np.uint64(cell)).astype(np.int64)
+    s = int(mapping.get_cell_length_in_indices(np.uint64(cell)))
+    L = np.asarray(mapping.length_in_indices, dtype=np.int64)
+
+    all_idx = mapping.get_indices(leaves).astype(np.int64)
+    all_len = mapping.get_cell_length_in_indices(leaves).astype(np.int64)
+
+    out = []
+    for h in hood:
+        t = idx + np.asarray(h) * s
+        ok = True
+        for d in range(3):
+            if (t[d] < 0 or t[d] >= L[d]) and not topology.periodic[d]:
+                ok = False
+        if not ok:
+            continue
+        t_mod = np.mod(t, L)
+        # leaves overlapping region [t_mod, t_mod + s - 1]
+        hits = np.nonzero(
+            ((all_idx <= t_mod) & (t_mod < all_idx + all_len[:, None])).all(axis=1)
+            | (
+                (t_mod <= all_idx) & (all_idx < t_mod + s)
+            ).all(axis=1)
+        )[0]
+        found = []
+        for j in hits:
+            nlvl = int(mapping.get_refinement_level(np.uint64(leaves[j])))
+            if nlvl >= lvl:  # same or finer: leaf inside slot
+                if ((t_mod <= all_idx[j]) & (all_idx[j] < t_mod + s)).all():
+                    found.append(j)
+            else:  # coarser: slot inside leaf
+                if ((all_idx[j] <= t_mod) & (t_mod < all_idx[j] + all_len[j])).all():
+                    found.append(j)
+        for j in sorted(found, key=lambda j: int(leaves[j])):
+            # offset: neighbor corner - cell corner, unwrapped to slot direction
+            corner = all_idx[j]
+            off = np.asarray(h) * s + (
+                np.mod(corner - t_mod, L) if True else corner - t_mod
+            )
+            # wrap the within-slot/within-coarse displacement to signed form
+            within = corner - t_mod
+            within = np.mod(within + L // 2, L) - L // 2
+            off = np.asarray(h) * s + within
+            out.append((int(leaves[j]), tuple(int(v) for v in off)))
+    return out
+
+
+def entries_of(lists, i):
+    ids, offs = lists.row(i)
+    return [(int(c), tuple(int(v) for v in o)) for c, o in zip(ids, offs)]
+
+
+def make_leafset(mapping, refine_cells=()):
+    """Leaf set = all level-0 cells, with given cells replaced by children."""
+    cells = set(range(1, int(np.prod(mapping.length)) + 1))
+    for c in refine_cells:
+        cells.remove(c)
+        for ch in mapping.get_all_children(np.uint64(c)):
+            cells.add(int(ch))
+    arr = np.array(sorted(cells), dtype=np.uint64)
+    return LeafSet(cells=arr, owner=np.zeros(len(arr), dtype=np.int32))
+
+
+@pytest.mark.parametrize("periodic", [(False,) * 3, (True,) * 3, (True, False, True)])
+@pytest.mark.parametrize("hood_len", [0, 1, 2])
+def test_uniform_grid_vs_oracle(periodic, hood_len):
+    m = Mapping(length=(4, 3, 2), max_refinement_level=0)
+    t = Topology(periodic=periodic)
+    leaves = make_leafset(m)
+    hood = default_neighborhood(hood_len)
+    lists = find_all_neighbors(m, t, leaves, hood)
+    for i in range(len(leaves)):
+        got = entries_of(lists, i)
+        want = oracle_neighbors(m, t, leaves.cells, hood, int(leaves.cells[i]))
+        assert sorted(got) == sorted(want), f"cell {leaves.cells[i]}"
+
+
+@pytest.mark.parametrize("periodic", [(False,) * 3, (True,) * 3])
+@pytest.mark.parametrize("hood_len", [0, 1])
+def test_refined_grid_vs_oracle(periodic, hood_len):
+    m = Mapping(length=(3, 3, 3), max_refinement_level=2)
+    t = Topology(periodic=periodic)
+    # refine the center cell (id 14) - its children abut every level-0 face
+    leaves = make_leafset(m, refine_cells=[14])
+    hood = default_neighborhood(hood_len)
+    lists = find_all_neighbors(m, t, leaves, hood)
+    for i in range(len(leaves)):
+        got = entries_of(lists, i)
+        want = oracle_neighbors(m, t, leaves.cells, hood, int(leaves.cells[i]))
+        assert sorted(got) == sorted(want), f"cell {leaves.cells[i]}"
+
+
+def test_refined_neighbor_expansion_order():
+    """A slot covered by finer cells yields all 8 siblings x-fastest."""
+    m = Mapping(length=(2, 1, 1), max_refinement_level=1)
+    leaves = make_leafset(m, refine_cells=[2])
+    t = Topology()
+    hood = default_neighborhood(0)
+    lists = find_all_neighbors(m, t, leaves, hood)
+    # cell 1 (level 0) has +x slot covered by cell 2's children
+    i = int(leaves.position(np.uint64(1)))
+    ids, offs = lists.row(i)
+    children = m.get_all_children(np.uint64(2))
+    sel = [(int(c), tuple(map(int, o))) for c, o in zip(ids, offs) if int(c) in set(children.tolist())]
+    assert [c for c, _ in sel] == [int(c) for c in children]
+    # offsets: +x slot at x=2 (s=2, half=1): {2,3} x {0,1} x {0,1}
+    assert sel[0][1] == (2, 0, 0)
+    assert sel[1][1] == (3, 0, 0)
+    assert sel[4][1] == (2, 0, 1)
+
+
+def test_coarse_neighbor_appears_once_per_slot():
+    m = Mapping(length=(2, 2, 1), max_refinement_level=1)
+    leaves = make_leafset(m, refine_cells=[1])
+    t = Topology()
+    hood = default_neighborhood(1)
+    lists = find_all_neighbors(m, t, leaves, hood)
+    # a child of cell 1 adjacent to coarse cell 2 sees it via several slots
+    ch = m.get_all_children(np.uint64(1))
+    i = int(leaves.position(ch[1]))  # child at +x side
+    ids, _ = lists.row(i)
+    assert (ids == 2).sum() >= 2
+
+
+def test_periodic_self_neighbor():
+    """Length-1 periodic dimension: a cell wraps to itself."""
+    m = Mapping(length=(1, 1, 1), max_refinement_level=0)
+    t = Topology(periodic=(True, True, True))
+    leaves = make_leafset(m)
+    lists = find_all_neighbors(m, t, leaves, default_neighborhood(0))
+    ids, offs = lists.row(0)
+    assert (ids == 1).all() and len(ids) == 6
+
+
+def test_invert_neighbors_symmetric_on_uniform():
+    m = Mapping(length=(3, 3, 1), max_refinement_level=0)
+    t = Topology()
+    leaves = make_leafset(m)
+    lists = find_all_neighbors(m, t, leaves, default_neighborhood(1))
+    start, src = invert_neighbors(len(leaves), lists)
+    # uniform grid: neighbors_to == neighbors_of set
+    for j in range(len(leaves)):
+        to_set = set(src[start[j] : start[j + 1]].tolist())
+        of_set = set(lists.nbr_pos[lists.start[j] : lists.start[j + 1]].tolist())
+        assert to_set == of_set
