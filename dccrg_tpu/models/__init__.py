@@ -1,0 +1,3 @@
+from .game_of_life import GameOfLife
+
+__all__ = ["GameOfLife"]

@@ -1,0 +1,72 @@
+"""Device-side stencil support: neighbor gather tables as sharded arrays.
+
+The reference's iteration facade hands user code cached per-cell neighbor
+pointer lists (``Cells_Item``/``Neighbors_Item``, ``dccrg.hpp:7279-7602``).
+The TPU-native equivalent is a set of dense ``[D, R, K]`` gather tables —
+row indices, validity masks, offsets, sizes — materialized on device once
+per (epoch, neighborhood) so jitted workload kernels are pure array programs
+with no host involvement.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import shard_spec
+
+__all__ = ["StencilTables", "gather_neighbors"]
+
+
+class StencilTables:
+    """Sharded device arrays describing one neighborhood's structure.
+
+    Attributes (all ``jax.Array`` sharded on the leading device axis):
+      nbr_rows   [D, R, K] int32 — row of each neighbor entry (scratch-padded)
+      nbr_valid  [D, R, K] bool  — entry exists
+      nbr_offset [D, R, K, 3] int32 — neighbor min corner - cell min corner
+                 in index units (reference ``Neighbors_Item.x/y/z``)
+      nbr_len    [D, R, K] int32 — neighbor edge length in index units
+      nbr_slot   [D, R, K] int32 — originating neighborhood-offset index
+      cell_len   [D, R] int32 — cell edge length in index units
+      cell_level [D, R] int8
+      local_mask / inner_mask / outer_mask  [D, R] bool
+    """
+
+    def __init__(self, grid, hood_id=None, with_geometry: bool = False):
+        epoch = grid.epoch
+        hood = epoch.hoods[hood_id]
+        mesh = grid.mesh
+        put = lambda a: jax.device_put(jnp.asarray(a), shard_spec(mesh, np.ndim(a)))
+        self.nbr_rows = put(hood.nbr_rows)
+        self.nbr_valid = put(hood.nbr_valid)
+        self.nbr_offset = put(hood.nbr_offset)
+        self.nbr_len = put(hood.nbr_len)
+        self.nbr_slot = put(hood.nbr_slot)
+        self.cell_len = put(epoch.cell_len)
+        self.cell_level = put(epoch.cell_level)
+        self.local_mask = put(epoch.local_mask)
+        self.inner_mask = put(hood.inner_mask)
+        self.outer_mask = put(hood.outer_mask)
+        if with_geometry:
+            # physical centers and edge lengths per row (ghosts included)
+            ids = epoch.cell_ids
+            centers = grid.geometry.get_center(ids)
+            lengths = grid.geometry.get_length(ids)
+            pad = ~epoch.local_mask & (epoch.cell_len == 0)
+            centers[pad] = 0.0
+            lengths[pad] = 1.0
+            self.center = put(centers)
+            self.length = put(lengths)
+
+    def tree(self) -> dict:
+        """The tables as a pytree (to pass through jit boundaries)."""
+        return dict(self.__dict__)
+
+
+def gather_neighbors(x, nbr_rows):
+    """Gather neighbor rows: x [D, R, ...] + nbr_rows [D, R, K] ->
+    [D, R, K, ...].  Inside a per-device block this is a single XLA gather;
+    with both operands sharded on D it needs no communication."""
+    D = x.shape[0]
+    return x[jnp.arange(D)[:, None, None], nbr_rows]

@@ -1,0 +1,86 @@
+"""Game-of-life end-to-end tests, mirroring the reference's blinker
+verification (examples/simple_game_of_life.cpp:122-158) and the
+device-count-invariance expectation of its test suite."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+from dccrg_tpu.models import GameOfLife
+
+
+def make_gol(n_dev=None):
+    g = (
+        Grid()
+        .set_initial_length((10, 10, 1))
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .set_load_balancing_method("RCB")
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    return g, GameOfLife(g)
+
+
+def test_blinker_oscillates():
+    grid, gol = make_gol()
+    # blinker at cells 54, 55, 56 (a horizontal row in the 10x10 grid)
+    state = gol.new_state(alive_cells=[54, 55, 56])
+    for turn in range(1, 21):
+        state = gol.step(state)
+        alive = set(gol.alive_cells(state).tolist())
+        assert 55 in alive, f"turn {turn}"
+        if turn % 2 == 1:  # after odd number of steps: vertical
+            assert alive == {45, 55, 65}, f"turn {turn}"
+        else:  # back to horizontal
+            assert alive == {54, 55, 56}, f"turn {turn}"
+
+
+def test_block_still_life():
+    grid, gol = make_gol()
+    block = [44, 45, 54, 55]
+    state = gol.new_state(alive_cells=block)
+    state = gol.run(state, 5)
+    assert set(gol.alive_cells(state).tolist()) == set(block)
+
+
+def test_glider_moves():
+    grid, gol = make_gol()
+    # glider in the upper-left corner: cells (x,y): (1,0),(2,1),(0,2),(1,2),(2,2)
+    ids = [1 + 1 + 0 * 10, 1 + 2 + 1 * 10, 1 + 0 + 2 * 10, 1 + 1 + 2 * 10, 1 + 2 + 2 * 10]
+    state = gol.new_state(alive_cells=ids)
+    state = gol.run(state, 4)
+    # after 4 steps a glider translates by (1, 1)
+    expect = {i + 1 + 1 * 10 for i in ids}
+    assert set(gol.alive_cells(state).tolist()) == expect
+
+
+def test_device_count_invariance():
+    """Rank-count-invariant results, the reference suite's core property."""
+    finals = []
+    rng = np.random.default_rng(11)
+    alive0 = (rng.random(100) < 0.35).nonzero()[0] + 1
+    for n_dev in (1, 3, 8):
+        grid, gol = make_gol(n_dev=n_dev)
+        state = gol.new_state(alive_cells=alive0.astype(np.uint64))
+        state = gol.run(state, 10)
+        finals.append(frozenset(gol.alive_cells(state).tolist()))
+    assert finals[0] == finals[1] == finals[2]
+
+
+def test_periodic_gol_wraps():
+    g = (
+        Grid()
+        .set_initial_length((8, 8, 1))
+        .set_periodic(True, True, False)
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh())
+    )
+    gol = GameOfLife(g)
+    # blinker crossing the x boundary: row y=3, cells x = 7, 0, 1
+    ids = [1 + 7 + 3 * 8, 1 + 0 + 3 * 8, 1 + 1 + 3 * 8]
+    state = gol.new_state(alive_cells=ids)
+    state = gol.step(state)
+    alive = set(gol.alive_cells(state).tolist())
+    # vertical blinker at x=0: y = 2,3,4
+    assert alive == {1 + 0 + 2 * 8, 1 + 0 + 3 * 8, 1 + 0 + 4 * 8}
+    state = gol.step(state)
+    assert set(gol.alive_cells(state).tolist()) == set(ids)
