@@ -1,3 +1,4 @@
+from .advection import Advection
 from .game_of_life import GameOfLife
 
-__all__ = ["GameOfLife"]
+__all__ = ["Advection", "GameOfLife"]

@@ -70,3 +70,19 @@ def gather_neighbors(x, nbr_rows):
     with both operands sharded on D it needs no communication."""
     D = x.shape[0]
     return x[jnp.arange(D)[:, None, None], nbr_rows]
+
+
+def ordered_sum(x, axis: int = -1):
+    """Sum with a guaranteed left-to-right association chain.
+
+    ``jnp.sum`` lets XLA pick a reduction tree that varies with array shape,
+    so the same per-cell neighbor contributions can differ in the last ulp
+    between device counts.  Workloads that promise bit-identical results
+    across partitions (BASELINE's halo/flux determinism requirement) reduce
+    their static neighbor axis with this instead."""
+    K = x.shape[axis]
+    parts = [jax.lax.index_in_dim(x, k, axis=axis, keepdims=False) for k in range(K)]
+    total = parts[0]
+    for p in parts[1:]:
+        total = total + p
+    return total
