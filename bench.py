@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""North-star benchmark: 3-D advection cell-updates/sec/chip.
+
+Runs the advection workload (models/advection.py, semantics of the
+reference's tests/advection) on the available accelerator and compares
+against the CPU denominator required by BASELINE.md: the reference itself
+(dccrg + MPI + Zoltan) cannot be built in this image, so the denominator is
+tools/cpu_baseline.cpp — the same per-cell upwind scheme with the
+reference's AoS 9-double cell layout and neighbor indirection, g++ -O3
+-fopenmp over all host cores (documented in BASELINE.md's protocol as the
+locally-measured stand-in).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent
+
+# benchmark configuration: 3-D advection, f32 on accelerator (the reference
+# is f64-on-CPU; f32 is the TPU-native precision choice and is recorded)
+NX, NY, NZ = 128, 128, 64
+STEPS = 500
+
+
+def measure_tpu() -> dict:
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Advection
+
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    g = (
+        Grid()
+        .set_initial_length((NX, NY, NZ))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / NX, 1.0 / NY, 1.0 / NZ),
+        )
+        .initialize(mesh=mesh)
+    )
+    adv = Advection(g, dtype=np.float32)
+    state = adv.initialize_state()
+    dt = np.float32(0.4 * adv.max_time_step(state))
+
+    # warmup + compile (device-side loop: one dispatch for the whole run)
+    jax.block_until_ready(adv.run(state, 2, dt))
+
+    t0 = time.perf_counter()
+    state = adv.run(state, STEPS, dt)
+    jax.block_until_ready(state)
+    secs = time.perf_counter() - t0
+
+    n_cells = NX * NY * NZ
+    updates_per_s = n_cells * STEPS / secs
+    halo = g.halo(None)
+    halo_bytes = halo.bytes_moved({"density": state["density"]}) * STEPS
+    return {
+        "updates_per_s": updates_per_s,
+        "updates_per_s_per_chip": updates_per_s / n_dev,
+        "n_devices": n_dev,
+        "platform": jax.devices()[0].platform,
+        "halo_GBps": halo_bytes / secs / 1e9,
+        "secs": secs,
+    }
+
+
+def measure_cpu_baseline() -> float:
+    """Build + run the C++ CPU denominator; cached in BASELINE_LOCAL.json."""
+    cache = ROOT / "BASELINE_LOCAL.json"
+    key = f"advection_{NX}x{NY}x{NZ}"
+    if cache.exists():
+        data = json.loads(cache.read_text())
+        if key in data:
+            return data[key]
+    exe = ROOT / "tools" / "cpu_baseline"
+    src = ROOT / "tools" / "cpu_baseline.cpp"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-fopenmp", "-o", str(exe), str(src)],
+        check=True,
+    )
+    out = subprocess.run(
+        [str(exe), str(NX), str(NY), str(NZ), "10"],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    value = float(out.stdout.strip())
+    data = json.loads(cache.read_text()) if cache.exists() else {}
+    data[key] = value
+    cache.write_text(json.dumps(data, indent=1))
+    return value
+
+
+def main():
+    tpu = measure_tpu()
+    try:
+        cpu = measure_cpu_baseline()
+    except Exception as e:  # baseline build failure must not kill the bench
+        print(f"cpu baseline failed: {e}", file=sys.stderr)
+        cpu = None
+    vs = tpu["updates_per_s_per_chip"] / cpu if cpu else -1.0
+    print(
+        json.dumps(
+            {
+                "metric": "3d_advection_cell_updates_per_sec_per_chip",
+                "value": round(tpu["updates_per_s_per_chip"], 1),
+                "unit": "cell-updates/s/chip",
+                "vs_baseline": round(vs, 3),
+                "detail": {
+                    "grid": [NX, NY, NZ],
+                    "steps": STEPS,
+                    "platform": tpu["platform"],
+                    "n_devices": tpu["n_devices"],
+                    "halo_GBps": round(tpu["halo_GBps"], 3),
+                    "cpu_baseline_updates_per_s": cpu,
+                    "dtype": "float32",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

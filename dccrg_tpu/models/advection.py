@@ -40,11 +40,15 @@ class Advection:
         "max_diff": ((), np.float64),
     }
 
-    def __init__(self, grid, hood_id=None, dtype=np.float64):
+    def __init__(self, grid, hood_id=None, dtype=np.float64, allow_dense=True):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
+        self.dense = grid.epoch.dense if allow_dense else None
+        if self.dense is not None:
+            self._init_dense()
+            return
         self.tables = StencilTables(grid, hood_id, with_geometry=True)
         self._exchange = grid.halo(hood_id)
         self._build_face_tables()
@@ -208,13 +212,146 @@ class Advection:
 
         return max_diff
 
+    # ------------------------------------------------------ dense fast path
+
+    def _init_dense(self):
+        """Uniform-grid specialization (parallel/dense.py): payloads as
+        dense [D, nzl, ny, nx] z-slab blocks, the halo as two ppermute plane
+        transfers, and every face flux as shifted slices that XLA fuses into
+        one HBM pass — the layout the reference's per-cell object model
+        cannot express but the one a TPU needs."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.dense import HaloExtend
+        from ..parallel.mesh import SHARD_AXIS, shard_spec
+
+        info = self.dense
+        grid = self.grid
+        dtype = self.dtype
+        D, nzl, ny, nx = info.n_devices, info.nz_local, info.ny, info.nx
+        l0 = grid.geometry.get_level_0_cell_length()
+        self._dx = l0.astype(np.float64)
+        area = np.array([l0[1] * l0[2], l0[0] * l0[2], l0[0] * l0[1]])
+        vol = float(l0.prod())
+        self._vol = vol
+        px, py, pz = info.periodic
+        extend = HaloExtend(info)
+        mesh = grid.mesh
+        data_spec = P(SHARD_AXIS)
+
+        # Face validity masks for non-periodic boundaries.  "Face i" along a
+        # dimension sits between cell i and cell (i+1) mod n; the wrapping
+        # face is invalid unless that dimension is periodic (a neighborhood
+        # slot outside the grid has no neighbor, hence no flux).
+        mask_x = np.ones(nx)
+        mask_y = np.ones(ny)
+        if not px:
+            mask_x[-1] = 0.0
+        if not py:
+            mask_y[-1] = 0.0
+        # z-face validity per (device, local plane): face above plane g is
+        # invalid for the global top plane unless periodic
+        zface_up = np.ones((D, nzl))
+        if not pz:
+            zface_up[-1, -1] = 0.0
+        # validity of the face *below* plane g = validity of the face above
+        # plane g-1
+        zface_dn = np.roll(zface_up.reshape(-1), 1).reshape(D, nzl)
+        put = lambda a: jax.device_put(
+            jnp.asarray(a, dtype), shard_spec(mesh, np.ndim(a))
+        )
+        zf_up_dev, zf_dn_dev = put(zface_up), put(zface_dn)
+        mx = jnp.asarray(mask_x, dtype)[None, None, :]
+        my = jnp.asarray(mask_y, dtype)[None, :, None]
+        area = area.astype(dtype)
+
+        def face_flux(rho_c, rho_n, v_c, v_n, area_d, dt):
+            # uniform cells: the reference's length-weighted face velocity
+            # (solve.hpp:168-175) reduces to the plain average
+            v_face = (v_c + v_n) * dtype(0.5)
+            up = jnp.where(v_face >= 0, rho_c, rho_n)
+            return up * dt * v_face * area_d
+
+        # Negative-side x/y faces: the flux through cell i's negative face
+        # equals the positive-side face flux of cell i-1, i.e.
+        # jnp.roll(f, 1, axis) — the boundary mask is already baked into f.
+        # Accumulation follows the general path's slot order (z-, y-, x-,
+        # x+, y+, z+); negative-side face flux enters the cell with +,
+        # positive-side leaves with - (solve.hpp:227-233).
+        def body(zf_up, zf_dn, rho, vx, vy, vz, dt):
+            rho, vx, vy, vz = rho[0], vx[0], vy[0], vz[0]
+            mz_up = zf_up[0][:, None, None]
+            mz_dn = zf_dn[0][:, None, None]
+            rho_e = extend(rho)
+            vz_e = extend(vz)
+
+            fx = face_flux(rho, jnp.roll(rho, -1, 2), vx, jnp.roll(vx, -1, 2), area[0], dt) * mx
+            fy = face_flux(rho, jnp.roll(rho, -1, 1), vy, jnp.roll(vy, -1, 1), area[1], dt) * my
+            fz = face_flux(rho, rho_e[2:], vz, vz_e[2:], area[2], dt) * mz_up
+            fz_dn = face_flux(rho_e[:-2], rho, vz_e[:-2], vz, area[2], dt) * mz_dn
+
+            flux = fz_dn
+            flux = flux + jnp.roll(fy, 1, 1)
+            flux = flux + jnp.roll(fx, 1, 2)
+            flux = flux - fx
+            flux = flux - fy
+            flux = flux - fz
+            return ((rho + flux * dtype(1.0 / vol))[None],)
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(data_spec, data_spec, data_spec, data_spec, data_spec, data_spec, P()),
+            out_specs=(data_spec,),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def step(state, dt):
+            (new_rho,) = fn(
+                zf_up_dev, zf_dn_dev,
+                state["density"], state["vx"], state["vy"], state["vz"],
+                jnp.asarray(dt, dtype),
+            )
+            return {**state, "density": new_rho}
+
+        self._step = step
+
+        dx = self._dx
+
+        @jax.jit
+        def max_dt(state):
+            s = jnp.stack(
+                [
+                    dtype(dx[0]) / jnp.abs(state["vx"]),
+                    dtype(dx[1]) / jnp.abs(state["vy"]),
+                    dtype(dx[2]) / jnp.abs(state["vz"]),
+                ],
+                axis=-1,
+            )
+            s = jnp.where(jnp.isfinite(s) & (s > 0), s, jnp.inf)
+            return jnp.min(s)
+
+        self._max_dt = max_dt
+        self._max_diff = None
+
+    def _dense_coords(self, ids):
+        """(device, local z, y, x) of given cell ids in the dense layout."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        i = self.dense
+        lin = (ids - np.uint64(1)).astype(np.int64)
+        x = lin % i.nx
+        y = (lin // i.nx) % i.ny
+        z = lin // (i.nx * i.ny)
+        return z // i.nz_local, z % i.nz_local, y, x
+
     # ----------------------------------------------------------- user API
 
     def initialize_state(self):
         """Rotating-hump initial condition (initialize.hpp:36-80): solid-body
         rotation about the domain center, cosine density hump."""
         grid = self.grid
-        state = grid.new_state(self.spec)
         cells = grid.get_cells()
         centers = grid.geometry.get_center(cells)
         vx = -centers[:, 1] + 0.5
@@ -225,6 +362,25 @@ class Advection:
             np.sqrt((centers[:, 0] - 0.25) ** 2 + (centers[:, 1] - 0.5) ** 2), radius
         ) / radius
         rho = 0.25 * (1 + np.cos(np.pi * r))
+
+        if self.dense is not None:
+            from ..parallel.mesh import shard_spec
+
+            i = self.dense
+            shape = (i.n_devices, i.nz_local, i.ny, i.nx)
+            state = {}
+            for name in self.spec:
+                state[name] = jnp.zeros(shape, dtype=self.dtype)
+            d, zl, y, x = self._dense_coords(cells)
+            for name, vals in (("density", rho), ("vx", vx), ("vy", vy), ("vz", vz)):
+                host = np.zeros(shape, dtype=self.dtype)
+                host[d, zl, y, x] = vals
+                state[name] = jax.device_put(
+                    jnp.asarray(host), shard_spec(self.grid.mesh, 4)
+                )
+            return state
+
+        state = grid.new_state(self.spec)
         state = grid.set_cell_data(state, "vx", cells, vx)
         state = grid.set_cell_data(state, "vy", cells, vy)
         state = grid.set_cell_data(state, "vz", cells, vz)
@@ -234,16 +390,61 @@ class Advection:
         state = self._exchange(state)
         return state
 
+    def get_cell_data(self, state, field: str, ids):
+        """Layout-aware per-cell read (dense or row layout)."""
+        if self.dense is not None:
+            d, zl, y, x = self._dense_coords(ids)
+            return np.asarray(state[field])[d, zl, y, x]
+        return self.grid.get_cell_data(state, field, ids)
+
+    def set_cell_data(self, state, field: str, ids, values):
+        if self.dense is not None:
+            from ..parallel.mesh import shard_spec
+
+            d, zl, y, x = self._dense_coords(ids)
+            host = np.array(state[field])
+            host[d, zl, y, x] = values
+            return {
+                **state,
+                field: jax.device_put(
+                    jnp.asarray(host), shard_spec(self.grid.mesh, 4)
+                ),
+            }
+        return self.grid.set_cell_data(state, field, ids, values)
+
     def step(self, state, dt):
         return self._step(state, dt)
+
+    def run(self, state, steps: int, dt):
+        """Advance ``steps`` timesteps in a single device-side loop
+        (``lax.fori_loop``) — one dispatch for the whole run, the
+        compiler-friendly form of the reference's while-loop driver
+        (2d.cpp:321+).  Use this for tight stepping; ``step`` for loops
+        interleaved with host logic (AMR, load balancing, IO)."""
+        if not hasattr(self, "_run"):
+            inner = self._step
+
+            @jax.jit
+            def run_fn(state, steps, dt):
+                return jax.lax.fori_loop(0, steps, lambda i, st: inner(st, dt), state)
+
+            self._run = run_fn
+        return self._run(state, steps, jnp.asarray(dt, self.dtype))
 
     def max_time_step(self, state) -> float:
         return float(self._max_dt(state))
 
     def compute_max_diff(self, state, diff_threshold: float):
+        if self._max_diff is None:
+            raise NotImplementedError(
+                "max_diff on the dense path: rebuild with allow_dense=False "
+                "(AMR decisions use the general path)"
+            )
         return self._max_diff(state, diff_threshold)
 
     def total_mass(self, state) -> float:
+        if self.dense is not None:
+            return float(np.asarray(state["density"], dtype=np.float64).sum() * self._vol)
         rho = np.asarray(state["density"])
         vol = 1.0 / np.where(self.inv_volume > 0, self.inv_volume, np.inf)
         local = np.asarray(self.tables.local_mask)

@@ -1,0 +1,82 @@
+"""Dense fast path for uniform (refinement-level-0) grids.
+
+The reference treats every grid — even a fully regular one — through its
+per-cell object machinery.  On TPU the idiomatic move is the opposite: when
+every leaf is at level 0 and the partition is z-slab aligned, each device's
+cells form a dense ``[nz_local, ny, nx]`` block (cell ids are x-fastest /
+z-slowest, ``dccrg_mapping.hpp:180-207``), stencils become shifted slices
+XLA fuses into single HBM passes, and the halo exchange collapses to two
+``lax.ppermute`` plane transfers over ICI.  AMR or irregular partitions fall
+back to the general gather path transparently.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import SHARD_AXIS
+
+__all__ = ["DenseInfo", "detect_dense", "HaloExtend"]
+
+
+@dataclass(frozen=True)
+class DenseInfo:
+    nx: int
+    ny: int
+    nz: int
+    nz_local: int          # z planes per device
+    n_devices: int
+    periodic: tuple
+
+
+def detect_dense(mapping, topology, leaves, n_devices: int) -> DenseInfo | None:
+    """A grid is dense-eligible iff every leaf is level 0 and ownership is
+    the id-order slab partition with D | nz."""
+    nx, ny, nz = mapping.length
+    if len(leaves) != nx * ny * nz:
+        return None  # something is refined
+    if nz % n_devices != 0:
+        return None
+    per = len(leaves) // n_devices
+    expected = np.repeat(np.arange(n_devices, dtype=np.int32), per)
+    if not np.array_equal(leaves.owner, expected):
+        return None
+    # leaves must be exactly the level-0 cells 1..n in order
+    if leaves.cells[0] != 1 or leaves.cells[-1] != nx * ny * nz:
+        return None
+    return DenseInfo(
+        nx=nx,
+        ny=ny,
+        nz=nz,
+        nz_local=nz // n_devices,
+        n_devices=n_devices,
+        periodic=topology.periodic,
+    )
+
+
+class HaloExtend:
+    """Per-device z-plane halo: extend a ``[nzl, ny, nx]`` block to
+    ``[nzl+2, ny, nx]`` with neighbor devices' boundary planes (ppermute up
+    and down the slab ring).  Intended for use *inside* shard_map bodies."""
+
+    def __init__(self, info: DenseInfo):
+        self.info = info
+        D = info.n_devices
+        self.up = [(i, (i + 1) % D) for i in range(D)]
+        self.down = [(i, (i - 1) % D) for i in range(D)]
+
+    def __call__(self, blk):
+        """blk: [nzl, ny, nx] (or with trailing dims). Returns [nzl+2, ...].
+        For a single device the ring degenerates to a local wrap."""
+        info = self.info
+        top = blk[-1:]                       # plane sent upward
+        bot = blk[:1]                        # plane sent downward
+        if info.n_devices == 1:
+            recv_below, recv_above = top, bot
+        else:
+            recv_below = jax.lax.ppermute(top, SHARD_AXIS, self.up)
+            recv_above = jax.lax.ppermute(bot, SHARD_AXIS, self.down)
+        return jnp.concatenate([recv_below, blk, recv_above], axis=0)

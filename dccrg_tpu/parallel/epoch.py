@@ -22,6 +22,7 @@ import numpy as np
 from ..core.mapping import Mapping
 from ..core.topology import Topology
 from ..core.neighbors import LeafSet, NeighborLists, find_all_neighbors, invert_neighbors
+from .dense import detect_dense
 
 __all__ = ["HoodState", "Epoch", "build_epoch"]
 
@@ -67,6 +68,8 @@ class Epoch:
     cell_ids: np.ndarray           # (D, R) uint64 cell id per row (0 pad)
     local_mask: np.ndarray         # (D, R) bool
     hoods: dict = field(default_factory=dict)   # hood id (None = default) -> HoodState
+    #: set when the grid qualifies for the dense uniform fast path
+    dense = None
 
     # ------------------------------------------------------------- lookups
 
@@ -194,6 +197,7 @@ def build_epoch(
         epoch.hoods[hid] = _finish_hood(
             epoch, offsets, lists, to_start, to_src, h_pairs, len_all
         )
+    epoch.dense = detect_dense(mapping, topology, leaves, D)
     return epoch
 
 

@@ -1,0 +1,96 @@
+// CPU baseline for the advection benchmark: the reference's per-cell upwind
+// flux loop (tests/advection/solve.hpp:43-260) on a uniform periodic 3-D
+// grid, with the reference's cell layout (9 doubles per cell,
+// tests/advection/cell.hpp:36-44), multi-threaded over all host cores.
+//
+// The actual reference (dccrg + MPI + Zoltan) cannot be built in this image
+// (no MPI/boost/Zoltan); this program re-creates its compute pattern --
+// AoS cells, neighbor indirection through an index list, double precision --
+// as the honest MPI-CPU denominator for BASELINE.md's protocol.
+//
+// Usage: cpu_baseline NX NY NZ STEPS  -> prints cell-updates/sec
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+struct Cell {
+    double data[9]; // density, vx, vy, vz, flux, max_diff, lx, ly, lz
+};
+
+int main(int argc, char** argv) {
+    const int64_t nx = argc > 1 ? atoll(argv[1]) : 128;
+    const int64_t ny = argc > 2 ? atoll(argv[2]) : 128;
+    const int64_t nz = argc > 3 ? atoll(argv[3]) : 64;
+    const int64_t steps = argc > 4 ? atoll(argv[4]) : 10;
+    const int64_t n = nx * ny * nz;
+
+    std::vector<Cell> cells(n);
+    // neighbor index list: 6 face neighbors per cell (periodic), the
+    // reference's neighbors_of indirection
+    std::vector<int64_t> nbr(n * 6);
+
+    const double dx = 1.0 / nx, dy = 1.0 / ny, dz = 1.0 / nz;
+    for (int64_t z = 0; z < nz; z++)
+    for (int64_t y = 0; y < ny; y++)
+    for (int64_t x = 0; x < nx; x++) {
+        const int64_t i = x + nx * (y + ny * z);
+        Cell& c = cells[i];
+        const double cx = (x + 0.5) * dx, cy = (y + 0.5) * dy;
+        c.data[0] = 0.25 * (1 + cos(M_PI * fmin(sqrt(pow(cx - 0.25, 2) + pow(cy - 0.5, 2)), 0.15) / 0.15));
+        c.data[1] = -cy + 0.5;
+        c.data[2] = cx - 0.5;
+        c.data[3] = 0.0;
+        c.data[4] = 0.0;
+        c.data[6] = dx; c.data[7] = dy; c.data[8] = dz;
+        nbr[i * 6 + 0] = ((x + nx - 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 1] = ((x + 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 2] = x + nx * (((y + ny - 1) % ny) + ny * z);
+        nbr[i * 6 + 3] = x + nx * (((y + 1) % ny) + ny * z);
+        nbr[i * 6 + 4] = x + nx * (y + ny * ((z + nz - 1) % nz));
+        nbr[i * 6 + 5] = x + nx * (y + ny * ((z + 1) % nz));
+    }
+
+    const double dt = 0.4 * dx / 0.5;
+    const auto t0 = std::chrono::high_resolution_clock::now();
+    for (int64_t s = 0; s < steps; s++) {
+        // flux sweep (each cell accumulates from all 6 faces; same work
+        // shape as the reference's pair-skipping scatter loop)
+#pragma omp parallel for schedule(static)
+        for (int64_t i = 0; i < n; i++) {
+            Cell& c = cells[i];
+            const double vol = c.data[6] * c.data[7] * c.data[8];
+            double flux = 0;
+            for (int k = 0; k < 6; k++) {
+                const Cell& o = cells[nbr[i * 6 + k]];
+                const int axis = k / 2;
+                const int sign = (k % 2) ? 1 : -1;
+                const double area = vol / c.data[6 + axis];
+                const double v = 0.5 * (c.data[1 + axis] + o.data[1 + axis]);
+                const double up = (sign > 0) == (v >= 0) ? ((sign > 0) ? c.data[0] : o.data[0])
+                                                         : ((sign > 0) ? o.data[0] : c.data[0]);
+                flux -= sign * up * dt * v * area;
+            }
+            c.data[4] = flux / vol;
+        }
+#pragma omp parallel for schedule(static)
+        for (int64_t i = 0; i < n; i++) {
+            cells[i].data[0] += cells[i].data[4];
+            cells[i].data[4] = 0;
+        }
+    }
+    const auto t1 = std::chrono::high_resolution_clock::now();
+    const double secs = std::chrono::duration<double>(t1 - t0).count();
+    // keep the result live
+    volatile double sink = cells[n / 2].data[0];
+    (void)sink;
+    printf("%.6e\n", double(n) * steps / secs);
+    return 0;
+}
