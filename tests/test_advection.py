@@ -70,7 +70,7 @@ def test_matches_dense_oracle():
 
     # dense arrays indexed [x, y]
     def to_dense(field):
-        vals = g.get_cell_data(state, field, cells)
+        vals = adv.get_cell_data(state, field, cells)
         idx = g.mapping.get_indices(cells)
         dense = np.zeros((n, n))
         dense[idx[:, 0], idx[:, 1]] = vals
@@ -85,7 +85,7 @@ def test_matches_dense_oracle():
         state = adv.step(state, dt)
         rho = dense_oracle_step(rho, vx, vy, dx, dt)
 
-    got = g.get_cell_data(state, "density", cells)
+    got = adv.get_cell_data(state, "density", cells)
     idx = g.mapping.get_indices(cells)
     np.testing.assert_allclose(got, rho[idx[:, 0], idx[:, 1]], rtol=1e-12, atol=1e-15)
 
@@ -104,7 +104,7 @@ def test_device_count_invariance():
         dt = 0.5 * adv.max_time_step(state)
         for _ in range(10):
             state = adv.step(state, dt)
-        results.append(g.get_cell_data(state, "density", g.get_cells()))
+        results.append(adv.get_cell_data(state, "density", g.get_cells()))
     np.testing.assert_allclose(results[0], results[1], rtol=1e-13, atol=1e-16)
     np.testing.assert_allclose(results[0], results[2], rtol=1e-13, atol=1e-16)
 
@@ -130,7 +130,7 @@ def test_hump_rotates():
     for _ in range(steps):
         state = adv.step(state, dt)
     cells = g.get_cells()
-    rho = g.get_cell_data(state, "density", cells)
+    rho = adv.get_cell_data(state, "density", cells)
     centers = g.geometry.get_center(cells)
     peak = centers[np.argmax(rho)]
     # hump starts at (0.25, 0.5); after quarter turn about (0.5, 0.5) it
@@ -143,7 +143,7 @@ def test_max_diff_indicator():
     g, adv = make_adv(n=16)
     state = adv.initialize_state()
     state = adv.compute_max_diff(state, diff_threshold=0.025)
-    md = g.get_cell_data(state, "max_diff", g.get_cells())
+    md = adv.get_cell_data(state, "max_diff", g.get_cells())
     assert (md >= 0).all()
     # steep hump edge -> some large indicators; far field flat -> zeros
     assert md.max() > 1.0
