@@ -1,0 +1,3 @@
+from .refinement import AmrQueues
+
+__all__ = ["AmrQueues"]

@@ -87,19 +87,21 @@ def find_all_neighbors(
     topology: Topology,
     leaves: LeafSet,
     hood: np.ndarray,
-    source_pos: np.ndarray | None = None,
+    source_cells: np.ndarray | None = None,
     strict: bool = True,
 ) -> NeighborLists:
-    """Compute neighbors-of for the cells at ``source_pos`` (default: all
+    """Compute neighbors-of for the given source cells (default: all
     leaves) against the full leaf set.  Vectorized over (cell, slot) pairs.
+    Sources need not be leaves themselves (used for would-be parents during
+    unrefinement checks); only their level/index arithmetic is used.
 
     With ``strict`` (the default) an inconsistent grid — a slot inside the
     grid covered by no leaf of level l-1/l/l+1 — raises, mirroring the
     reference's DEBUG invariants.
     """
-    if source_pos is None:
-        source_pos = np.arange(len(leaves), dtype=np.int64)
-    src_cells = leaves.cells[source_pos]
+    if source_cells is None:
+        source_cells = leaves.cells
+    src_cells = np.asarray(source_cells, dtype=np.uint64)
     N, K = len(src_cells), len(hood)
     mrl = mapping.max_refinement_level
 

@@ -107,6 +107,12 @@ class Grid:
         self.neighborhoods = {None: default_neighborhood(self._hood_length)}
         self.cell_weights = {}
         self.pin_requests = {}
+        from .amr.refinement import AmrQueues
+
+        self.amr = AmrQueues()
+        self._last_new_cells = np.zeros(0, dtype=np.uint64)
+        self._last_removed_cells = np.zeros(0, dtype=np.uint64)
+        self._prev_epoch = None
 
         n0 = int(np.prod(self._length))
         cells = np.arange(1, n0 + 1, dtype=np.uint64)
@@ -271,6 +277,232 @@ class Grid:
     def wait_remote_neighbor_copy_updates(self, state):
         """Split-phase wait: block until ghost rows are materialized."""
         return jax.block_until_ready(state)
+
+    # ------------------------------------------------------------------ AMR
+
+    def _leaf_level(self, cell) -> int:
+        pos = int(self.leaves.position(np.uint64(cell)))
+        if pos < 0:
+            return -1
+        return int(self.mapping.get_refinement_level(np.uint64(cell)))
+
+    def refine_completely(self, cell) -> bool:
+        """Queue a cell for refinement into 8 children at the next
+        ``stop_refining`` (reference ``dccrg.hpp:2434-2532``)."""
+        cell = int(cell)
+        lvl = self._leaf_level(cell)
+        if lvl < 0:
+            return False
+        if lvl == self.mapping.max_refinement_level:
+            self.dont_unrefine(cell)
+            return True
+        if cell in self.amr.not_to_refine:
+            return False
+        ids, _ = self.get_neighbors_of(cell)
+        n_lvl = self.mapping.get_refinement_level(ids)
+        if any(
+            int(n) in self.amr.not_to_refine
+            for n in ids[n_lvl < lvl]
+        ):
+            return False
+        self.amr.to_refine.add(cell)
+        # cancel conflicting unrefines: own siblings + same-or-coarser
+        # neighbors' siblings
+        for sib in self.mapping.get_siblings(np.uint64(cell)).tolist():
+            self.amr.to_unrefine.discard(sib)
+        both = np.concatenate([ids, self.get_neighbors_to(cell)])
+        for n, nl in zip(both, self.mapping.get_refinement_level(both)):
+            if nl <= lvl:
+                for sib in self.mapping.get_siblings(n).tolist():
+                    self.amr.to_unrefine.discard(sib)
+        return True
+
+    def unrefine_completely(self, cell) -> bool:
+        """Queue a cell's sibling family for replacement by its parent
+        (reference ``dccrg.hpp:2560-2655``)."""
+        cell = int(cell)
+        lvl = self._leaf_level(cell)
+        if lvl < 0:
+            return False
+        if lvl == 0:
+            return True
+        siblings = self.mapping.get_siblings(np.uint64(cell))
+        # all siblings must be leaves (no children)
+        if not self.leaves.exists(siblings).all():
+            return False
+        for sib in siblings.tolist():
+            if sib in self.amr.to_refine or sib in self.amr.not_to_unrefine:
+                return True
+        # parent's would-be neighborhood must not contain too-fine cells
+        from .amr.refinement import _find_for_nonleaves
+
+        parent = self.mapping.get_parent(np.uint64(cell))
+        plists = _find_for_nonleaves(
+            self.mapping, self.topology, self.leaves,
+            np.asarray([parent], dtype=np.uint64), self.neighborhoods[None],
+        )
+        pos = plists.nbr_pos
+        if (pos < 0).any():
+            return True  # no-op: neighbor more than one level finer
+        n_lvl = self.mapping.get_refinement_level(self.leaves.cells[pos])
+        p_lvl = lvl - 1
+        for n, nl in zip(self.leaves.cells[pos], n_lvl):
+            if nl == p_lvl + 1 and int(n) in self.amr.to_refine:
+                return True
+        # one sibling per family
+        for sib in siblings.tolist():
+            if sib in self.amr.to_unrefine:
+                return True
+        self.amr.to_unrefine.add(cell)
+        return True
+
+    def dont_refine(self, cell) -> bool:
+        cell = int(cell)
+        lvl = self._leaf_level(cell)
+        if lvl < 0:
+            return False
+        if lvl == self.mapping.max_refinement_level:
+            return True
+        self.amr.to_refine.discard(cell)
+        self.amr.not_to_refine.add(cell)
+        return True
+
+    def dont_unrefine(self, cell) -> bool:
+        cell = int(cell)
+        lvl = self._leaf_level(cell)
+        if lvl < 0:
+            return False
+        if lvl == 0:
+            return True
+        siblings = self.mapping.get_siblings(np.uint64(cell)).tolist()
+        if any(s in self.amr.not_to_unrefine for s in siblings):
+            return True
+        for s in siblings:
+            self.amr.to_unrefine.discard(s)
+        self.amr.not_to_unrefine.add(cell)
+        return True
+
+    def refine_completely_at(self, coords) -> bool:
+        c = self._cell_at(coords)
+        return bool(c) and self.refine_completely(c)
+
+    def unrefine_completely_at(self, coords) -> bool:
+        c = self._cell_at(coords)
+        return bool(c) and self.unrefine_completely(c)
+
+    def dont_refine_at(self, coords) -> bool:
+        c = self._cell_at(coords)
+        return bool(c) and self.dont_refine(c)
+
+    def dont_unrefine_at(self, coords) -> bool:
+        c = self._cell_at(coords)
+        return bool(c) and self.dont_unrefine(c)
+
+    def _cell_at(self, coords) -> int:
+        """Existing leaf containing given coordinates (searches from level 0
+        down, reference ``get_existing_cell``)."""
+        for lvl in range(self.mapping.max_refinement_level, -1, -1):
+            c = self.geometry.get_cell(lvl, np.asarray(coords, dtype=np.float64))
+            if int(c) and bool(self.leaves.exists(np.uint64(c))):
+                return int(c)
+        return 0
+
+    def stop_refining(self, sorted: bool = True) -> np.ndarray:
+        """Commit all queued refines/unrefines (veto -> induce -> override
+        -> execute, reference ``dccrg.hpp:3461-3485``); returns the new
+        cells.  Payload states allocated before this call must be carried
+        over with ``remap_state``."""
+        self._assert_initialized()
+        from .amr.refinement import commit_adaptation
+
+        self._prev_epoch = self.epoch
+        new_cells, removed = commit_adaptation(self)
+        self._last_new_cells = new_cells
+        self._last_removed_cells = removed
+        self._rebuild()
+        return new_cells.copy()
+
+    def get_removed_cells(self) -> np.ndarray:
+        """Cells removed by the last ``stop_refining`` (their parents are
+        now leaves) — reference ``dccrg.hpp:3488-3520``."""
+        return self._last_removed_cells.copy()
+
+    def remap_state(self, state, policy=None):
+        """Carry a payload state across the last structural change.
+
+        Surviving cells keep their values.  Per-field ``policy`` entries
+        control the rest: ``refine`` — how children get values from their
+        refined parent ("inherit" default, or "zero"); ``unrefine`` — how a
+        new parent reduces its removed children ("mean" default, "sum", or
+        "zero").  This is the array-level form of the reference pattern of
+        reading parent/child data after stop_refining
+        (tests/advection/adapter.hpp:230-292).
+        """
+        if self._prev_epoch is None:
+            return state
+        old, new = self._prev_epoch, self.epoch
+        policy = policy or {}
+        out = {}
+        old_cells = old.leaves.cells
+        new_cells = new.leaves.cells
+
+        # classification of new leaves
+        surv_pos_new = np.flatnonzero(old.leaves.exists(new_cells))
+        fresh_pos_new = np.flatnonzero(~old.leaves.exists(new_cells))
+        fresh = new_cells[fresh_pos_new]
+        fresh_lvl = self.mapping.get_refinement_level(fresh)
+        parents_of_fresh = self.mapping.get_parent(fresh)
+        # children created by refinement: their parent was an old leaf
+        is_child = old.leaves.exists(parents_of_fresh) & (fresh_lvl > 0)
+        # new parents from unrefinement: their children were old leaves
+        first_child = self.mapping.get_all_children(fresh)[:, 0]
+        is_parent = np.where(
+            fresh_lvl < self.mapping.max_refinement_level,
+            old.leaves.exists(first_child),
+            False,
+        ) & ~is_child
+
+        for name, arr in state.items():
+            host_old = np.asarray(arr, dtype=arr.dtype)
+            field_shape = host_old.shape[2:]
+            host_new = np.zeros((new.n_devices, new.R) + field_shape, host_old.dtype)
+            pol = policy.get(name, {})
+
+            def read(ids):
+                pos = old.leaves.position(ids)
+                dev = old.leaves.owner[pos]
+                row = old.row_of[pos]
+                return host_old[dev, row]
+
+            def write(ids, values):
+                pos = new.leaves.position(ids)
+                dev = new.leaves.owner[pos]
+                row = new.row_of[pos]
+                host_new[dev, row] = values
+
+            surv = new_cells[surv_pos_new]
+            write(surv, read(surv))
+
+            children = fresh[is_child]
+            if len(children):
+                if pol.get("refine", "inherit") == "inherit":
+                    write(children, read(parents_of_fresh[is_child]))
+
+            parents = fresh[is_parent]
+            if len(parents):
+                how = pol.get("unrefine", "mean")
+                if how in ("mean", "sum"):
+                    fam = self.mapping.get_all_children(parents)  # (M, 8)
+                    vals = read(fam.reshape(-1)).reshape((len(parents), 8) + field_shape)
+                    red = vals.sum(axis=1)
+                    if how == "mean":
+                        red = red / 8 if np.issubdtype(red.dtype, np.floating) else red // 8
+                    write(parents, red.astype(host_old.dtype))
+
+            out[name] = jax.device_put(
+                jnp.asarray(host_new), shard_spec(self.mesh, host_new.ndim)
+            )
+        return out
 
     # -------------------------------------------------------- introspection
 

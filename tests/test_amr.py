@@ -1,0 +1,187 @@
+"""AMR pipeline tests (reference analogues: tests/refine, the 2:1 balance
+DEBUG invariants, and the adapter's refine/unrefine interplay)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+
+
+def make_grid(length=(4, 4, 4), max_ref=2, hood=1, periodic=(False,) * 3, n_dev=None):
+    return (
+        Grid()
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(hood)
+        .set_periodic(*periodic)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+
+
+def check_two_to_one(grid):
+    """No neighbor pair differs by more than one refinement level; also the
+    epoch rebuild runs the strict neighbor search, so reaching here means
+    every slot resolved."""
+    h = grid.epoch.hoods[None]
+    lvl = grid.mapping.get_refinement_level(grid.leaves.cells)
+    src = np.repeat(np.arange(len(lvl)), np.diff(h.lists.start))
+    diff = np.abs(lvl[src] - lvl[h.lists.nbr_pos])
+    assert diff.max() <= 1 if len(diff) else True
+
+
+def test_refine_one_cell():
+    g = make_grid()
+    n0 = len(g.get_cells())
+    assert g.refine_completely(1)
+    new_cells = g.stop_refining()
+    assert len(new_cells) == 8
+    np.testing.assert_array_equal(
+        new_cells, g.mapping.get_all_children(np.uint64(1))
+    )
+    cells = g.get_cells()
+    assert len(cells) == n0 - 1 + 8
+    assert 1 not in cells
+    check_two_to_one(g)
+    # children live on the refined cell's device
+    assert (g.get_owner(new_cells) == 0).all()
+
+
+def test_refine_induces_2to1_balance():
+    g = make_grid(length=(8, 1, 1), max_ref=2, hood=1)
+    # refine cell 1 twice: second round must induce refinement of neighbors
+    g.refine_completely(1)
+    g.stop_refining()
+    check_two_to_one(g)
+    child = int(g.mapping.get_all_children(np.uint64(1))[0])
+    g.refine_completely(child)
+    new_cells = g.stop_refining()
+    check_two_to_one(g)
+    # cell 2 (level-0 neighbor of cell 1's children) must have been refined
+    assert 2 not in g.get_cells()
+    assert len(new_cells) > 8
+
+
+def test_dont_refine_veto():
+    g = make_grid()
+    g.refine_completely(1)
+    g.dont_refine(1)
+    new_cells = g.stop_refining()
+    assert len(new_cells) == 0
+    assert 1 in g.get_cells()
+
+
+def test_dont_refine_propagates_to_finer():
+    """A veto on a coarse cell also vetoes finer neighbors whose refinement
+    would force the vetoed cell to refine (override_refines fixed point)."""
+    g = make_grid(length=(8, 1, 1), max_ref=2, hood=1)
+    g.refine_completely(1)
+    g.stop_refining()
+    child = int(g.mapping.get_all_children(np.uint64(1))[0])
+    # cell 2 is a coarser neighbor of cell 1's children; vetoing cell 2 and
+    # refining a child of 1 would need 2 to refine -> child refine cancelled
+    g.dont_refine(2)
+    g.refine_completely(child)
+    new_cells = g.stop_refining()
+    assert len(new_cells) == 0
+    assert child in g.get_cells()
+
+
+def test_unrefine_roundtrip():
+    g = make_grid()
+    n0 = len(g.get_cells())
+    g.refine_completely(5)
+    children = g.stop_refining()
+    assert g.unrefine_completely(int(children[0]))
+    g.stop_refining()
+    removed = g.get_removed_cells()
+    np.testing.assert_array_equal(np.sort(removed), np.sort(children))
+    assert len(g.get_cells()) == n0
+    assert 5 in g.get_cells()
+    check_two_to_one(g)
+
+
+def test_unrefine_blocked_by_sibling_refine():
+    g = make_grid()
+    g.refine_completely(5)
+    children = g.stop_refining()
+    g.refine_completely(int(children[1]))
+    g.unrefine_completely(int(children[0]))  # same family: no-op
+    g.stop_refining()
+    assert 5 not in g.get_cells()
+    assert int(children[1]) not in g.get_cells()  # it was refined
+    check_two_to_one(g)
+
+
+def test_unrefine_blocked_by_finer_neighbor():
+    g = make_grid(length=(8, 1, 1), max_ref=2, hood=1)
+    g.refine_completely(1)
+    g.stop_refining()
+    child = int(g.mapping.get_all_children(np.uint64(1))[0])
+    g.refine_completely(child)
+    g.stop_refining()  # induces refinement of cell 2 as well
+    check_two_to_one(g)
+    # the family of cell 1's children now has grandchildren next to it;
+    # unrefining the other children of 1 would put a level-0... actually
+    # request unrefine of a child of 2's family whose neighbor is 2 levels
+    # finer - must be cancelled or refused
+    cells = g.get_cells()
+    lvl = g.mapping.get_refinement_level(cells)
+    n_before = len(cells)
+    for c in cells[lvl == 1]:
+        g.unrefine_completely(int(c))
+    g.stop_refining()
+    check_two_to_one(g)
+
+
+def test_remap_state_policies():
+    g = make_grid(length=(2, 2, 1), max_ref=1, hood=1)
+    state = g.new_state({"rho": ((), np.float64), "cnt": ((), np.int32)})
+    cells = g.get_cells()
+    state = g.set_cell_data(state, "rho", cells, np.array([1.0, 2.0, 3.0, 4.0]))
+    state = g.set_cell_data(state, "cnt", cells, np.arange(4, dtype=np.int32))
+
+    g.refine_completely(1)
+    children = g.stop_refining()
+    state = g.remap_state(state)
+    # children inherit parent's value; survivors keep theirs
+    np.testing.assert_array_equal(
+        g.get_cell_data(state, "rho", children), np.ones(8)
+    )
+    np.testing.assert_array_equal(
+        g.get_cell_data(state, "rho", np.array([2, 3, 4], dtype=np.uint64)),
+        [2.0, 3.0, 4.0],
+    )
+
+    # modify children then unrefine: parent = mean
+    state = g.set_cell_data(state, "rho", children, np.arange(8, dtype=np.float64))
+    g.unrefine_completely(int(children[0]))
+    g.stop_refining()
+    state = g.remap_state(state, policy={"rho": {"unrefine": "mean"}})
+    assert float(g.get_cell_data(state, "rho", np.array([1], np.uint64))[0]) == pytest.approx(3.5)
+
+
+def test_device_count_invariant_structure():
+    """The committed structure must not depend on the device count."""
+    results = []
+    for n_dev in (1, 8):
+        g = make_grid(length=(4, 4, 1), max_ref=2, n_dev=n_dev)
+        g.refine_completely(1)
+        g.refine_completely(6)
+        g.stop_refining()
+        g.refine_completely(int(g.mapping.get_all_children(np.uint64(1))[0]))
+        g.stop_refining()
+        results.append(g.get_cells())
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_refine_at_coordinates():
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_maximum_refinement_level(1)
+        .set_geometry(None, start=(0.0, 0.0, 0.0), level_0_cell_length=(0.25, 0.25, 1.0))
+        .initialize(mesh=make_mesh())
+    )
+    assert g.refine_completely_at((0.1, 0.1, 0.5))
+    new_cells = g.stop_refining()
+    assert len(new_cells) == 8
+    assert 1 not in g.get_cells()
