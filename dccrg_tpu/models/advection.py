@@ -442,6 +442,63 @@ class Advection:
             )
         return self._max_diff(state, diff_threshold)
 
+    # --------------------------------------------------------- AMR driver
+
+    def check_for_adaptation(
+        self,
+        state,
+        diff_increase: float = 0.025,
+        diff_threshold: float = 0.25,
+        unrefine_sensitivity: float = 0.5,
+    ):
+        """The reference's adaptation criterion (adapter.hpp:47-178): refine
+        where the max relative density difference to face neighbors exceeds
+        (level+1)*diff_increase, unrefine where it falls below
+        unrefine_sensitivity times that; queues requests on the grid."""
+        grid = self.grid
+        if grid.mapping.max_refinement_level == 0:
+            return state
+        state = self.compute_max_diff(state, diff_threshold)
+        cells = grid.get_cells()
+        md = self.get_cell_data(state, "max_diff", cells)
+        lvl = grid.mapping.get_refinement_level(cells)
+        refine_diff = (lvl + 1) * diff_increase
+        unrefine_diff = unrefine_sensitivity * refine_diff
+        for c in cells[md > refine_diff]:
+            grid.refine_completely(int(c))
+        hold = (md <= refine_diff) & (md >= unrefine_diff)
+        for c in cells[hold & (lvl > 0)]:
+            grid.dont_unrefine(int(c))
+        for c in cells[(md < unrefine_diff) & (lvl > 0)]:
+            grid.unrefine_completely(int(c))
+        return state
+
+    def adapt_grid(self, state):
+        """Commit queued adaptation and carry the state over: children
+        inherit the parent's density, new parents average their children
+        (adapter.hpp:230-292); velocities are re-derived from the rotation
+        field at the new cell centers (adapter.hpp:300-310).  Returns a NEW
+        Advection bound to the new grid structure plus the remapped state."""
+        grid = self.grid
+        new_cells = grid.stop_refining()
+        removed = grid.get_removed_cells()
+        state = grid.remap_state(
+            state,
+            policy={
+                "density": {"refine": "inherit", "unrefine": "mean"},
+                "flux": {"refine": "zero", "unrefine": "zero"},
+                "max_diff": {"refine": "zero", "unrefine": "zero"},
+            },
+        )
+        adv = Advection(grid, self.hood_id, self.dtype, allow_dense=False)
+        cells = grid.get_cells()
+        centers = grid.geometry.get_center(cells)
+        state = grid.set_cell_data(state, "vx", cells, -centers[:, 1] + 0.5)
+        state = grid.set_cell_data(state, "vy", cells, centers[:, 0] - 0.5)
+        state = grid.set_cell_data(state, "vz", cells, np.zeros(len(cells)))
+        state = adv._exchange(state)
+        return adv, state, new_cells, removed
+
     def total_mass(self, state) -> float:
         if self.dense is not None:
             return float(np.asarray(state["density"], dtype=np.float64).sum() * self._vol)

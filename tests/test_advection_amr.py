@@ -1,0 +1,78 @@
+"""Advection with dynamic AMR — the reference's tests/advection 2d.cpp flow:
+initialize, pre-adapt around the hump, then step/adapt, checking mass
+conservation and 2:1 balance throughout."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models import Advection
+
+from test_amr import check_two_to_one
+
+
+def make(n=10, max_ref=2, n_dev=None):
+    g = (
+        Grid()
+        .set_initial_length((n, n, 1))
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, False)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n, 1.0 / n, 1.0 / n),
+        )
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    return g, Advection(g, allow_dense=False)
+
+
+def test_initial_adaptation_refines_hump_edge():
+    g, adv = make()
+    state = adv.initialize_state()
+    n0 = len(g.get_cells())
+    state = adv.check_for_adaptation(state)
+    adv, state, new_cells, removed = adv.adapt_grid(state)
+    assert len(new_cells) > 0
+    assert len(g.get_cells()) > n0
+    check_two_to_one(g)
+    # refined cells cluster near the hump edge (x in [0.1, 0.4])
+    centers = g.geometry.get_center(new_cells)
+    assert (np.abs(centers[:, 0] - 0.25) < 0.3).all()
+
+
+def test_amr_run_conserves_mass():
+    g, adv = make(n=8, max_ref=1)
+    state = adv.initialize_state()
+    # pre-adaptation rounds like 2d.cpp:267-289
+    for _ in range(1):
+        state = adv.check_for_adaptation(state)
+        adv, state, _, _ = adv.adapt_grid(state)
+    m0 = adv.total_mass(state)
+    dt = 0.25 * adv.max_time_step(state)
+    for step in range(6):
+        state = adv.step(state, dt)
+        state = adv.check_for_adaptation(state)
+        adv, state, _, _ = adv.adapt_grid(state)
+        check_two_to_one(g)
+    # unrefinement averaging loses no mass; refinement inheritance neither
+    assert adv.total_mass(state) == pytest.approx(m0, rel=1e-10)
+    # density field stays sane
+    rho = adv.get_cell_data(state, "density", g.get_cells())
+    assert (rho >= -1e-12).all()
+    assert rho.max() <= 0.51
+
+
+def test_amr_structure_device_count_invariant():
+    structs = []
+    for n_dev in (1, 8):
+        g, adv = make(n=8, max_ref=1, n_dev=n_dev)
+        state = adv.initialize_state()
+        state = adv.check_for_adaptation(state)
+        adv, state, _, _ = adv.adapt_grid(state)
+        dt = 0.25 * adv.max_time_step(state)
+        state = adv.step(state, dt)
+        state = adv.check_for_adaptation(state)
+        adv, state, _, _ = adv.adapt_grid(state)
+        structs.append(g.get_cells())
+    np.testing.assert_array_equal(structs[0], structs[1])
