@@ -1,0 +1,128 @@
+"""Load balancing tests (reference analogues: tests/load_balancing,
+pinned_cells, hierarchical_test)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models import GameOfLife
+
+
+def make_grid(method="RCB", length=(8, 8, 1), n_dev=None, hood=1):
+    return (
+        Grid()
+        .set_initial_length(length)
+        .set_neighborhood_length(hood)
+        .set_load_balancing_method(method)
+        .set_geometry(
+            CartesianGeometry, start=(0.0, 0.0, 0.0), level_0_cell_length=(1.0, 1.0, 1.0)
+        )
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+
+
+@pytest.mark.parametrize("method", ["RCB", "HSFC", "BLOCK", "GRAPH"])
+def test_balance_produces_even_partition(method):
+    g = make_grid(method)
+    g.balance_load()
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=8)
+    assert counts.sum() == 64
+    assert counts.max() - counts.min() <= 2
+
+
+def test_none_keeps_partition():
+    g = make_grid("NONE")
+    before = g.get_owner(g.get_cells())
+    g.balance_load()
+    np.testing.assert_array_equal(g.get_owner(g.get_cells()), before)
+
+
+def test_weights_skew_partition():
+    g = make_grid("BLOCK", length=(16, 1, 1))
+    # make the first 4 cells very heavy: they should spread over devices
+    for c in range(1, 5):
+        g.set_cell_weight(c, 100.0)
+    g.balance_load()
+    owners = g.get_owner(np.arange(1, 5, dtype=np.uint64))
+    assert len(set(owners.tolist())) >= 3
+
+
+def test_pinning_overrides_partitioner():
+    g = make_grid("RCB")
+    assert g.pin(1, 7)
+    assert g.pin(64, 0)
+    g.balance_load()
+    assert int(g.get_owner(np.uint64(1))) == 7
+    assert int(g.get_owner(np.uint64(64))) == 0
+    # unpin and rebalance: partitioner decides again
+    g.unpin(1)
+    g.unpin_all_cells()
+    g.balance_load()
+
+
+def test_balance_load_preserves_data():
+    g = make_grid("RCB")
+    state = g.new_state({"v": ((), np.float64)})
+    cells = g.get_cells()
+    vals = np.sin(cells.astype(np.float64))
+    state = g.set_cell_data(state, "v", cells, vals)
+    g.balance_load()
+    state = g.remap_state(state)
+    np.testing.assert_array_equal(g.get_cell_data(state, "v", cells), vals)
+
+
+def test_gol_correct_after_balance():
+    """The reference's pinned/RCB GoL tests: physics must be identical
+    before and after repartitioning."""
+    g1 = make_grid("BLOCK", length=(10, 10, 1))
+    gol1 = GameOfLife(g1)
+    s1 = gol1.new_state(alive_cells=[54, 55, 56, 12, 13, 22])
+    s1 = gol1.run(s1, 5)
+    final1 = set(gol1.alive_cells(s1).tolist())
+
+    g2 = make_grid("RCB", length=(10, 10, 1))
+    gol2 = GameOfLife(g2)
+    s2 = gol2.new_state(alive_cells=[54, 55, 56, 12, 13, 22])
+    s2 = gol2.run(s2, 2)
+    g2.balance_load()
+    s2 = g2.remap_state(s2)
+    gol2 = GameOfLife(g2)  # tables rebind to the new epoch
+    s2 = gol2.run(s2, 3)
+    assert set(gol2.alive_cells(s2).tolist()) == final1
+
+
+def test_hierarchical_partitioning():
+    g = make_grid("RCB")
+    g.add_partitioning_level(4)  # 2 groups of 4 devices
+    g.balance_load()
+    owners = g.get_owner(g.get_cells())
+    counts = np.bincount(owners, minlength=8)
+    assert counts.sum() == 64
+    assert counts.max() - counts.min() <= 4
+    # group structure: cells of devices 0-3 form one spatial half
+    centers = g.geometry.get_center(g.get_cells())
+    grp = owners // 4
+    # the two groups should split space reasonably (not interleaved): check
+    # that each group's bounding box is smaller than the full domain in at
+    # least one dimension
+    for gi in (0, 1):
+        ext = centers[grp == gi].max(axis=0) - centers[grp == gi].min(axis=0)
+        full = centers.max(axis=0) - centers.min(axis=0)
+        assert (ext < full - 1e-9).any()
+
+
+def test_balance_after_refinement_with_weights():
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .set_load_balancing_method("HSFC")
+        .initialize(mesh=make_mesh())
+    )
+    g.refine_completely(1)
+    g.refine_completely(16)
+    g.stop_refining()
+    g.balance_load()
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=8)
+    assert counts.sum() == len(g.get_cells())
+    assert counts.max() - counts.min() <= 2
