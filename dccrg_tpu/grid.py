@@ -625,6 +625,31 @@ class Grid:
             )
         return out
 
+    # ------------------------------------------------------------------- IO
+
+    def save_grid_data(self, state, path: str, spec, user_header: bytes = b""):
+        """Checkpoint grid structure + payloads (reference
+        ``save_grid_data``, ``dccrg.hpp:1089-1716``)."""
+        from .io.checkpoint import save_grid_data as _save
+
+        _save(self, state, path, spec, user_header)
+
+    @staticmethod
+    def load_grid_data(path: str, spec, mesh=None, n_devices=None):
+        """Recreate a saved grid on the current devices; any device count
+        works (reference ``load_grid_data``, ``dccrg.hpp:1742-2404``).
+        Returns (grid, state, user_header)."""
+        from .io.checkpoint import load_grid_data as _load
+
+        return _load(path, spec, mesh=mesh, n_devices=n_devices)
+
+    def write_vtk_file(self, path: str, scalars: dict | None = None):
+        """Dump leaf-cell geometry (+ optional scalars) as legacy ASCII VTK
+        (reference ``dccrg.hpp:3298-3370``)."""
+        from .io.vtk import write_vtk_file as _vtk
+
+        _vtk(self, path, scalars)
+
     # -------------------------------------------------------- introspection
 
     def get_number_of_update_send_cells(self, device: int, hood_id=None) -> int:

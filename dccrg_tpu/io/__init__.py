@@ -1,0 +1,4 @@
+from .checkpoint import load_grid_data, save_grid_data
+from .vtk import write_vtk_file
+
+__all__ = ["load_grid_data", "save_grid_data", "write_vtk_file"]

@@ -1,0 +1,40 @@
+"""ASCII VTK (legacy UNSTRUCTURED_GRID) writer for visual inspection —
+the reference's ``write_vtk_file`` (``dccrg.hpp:3298-3370``) plus optional
+per-cell scalar fields (the reference's tests append these by hand)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["write_vtk_file"]
+
+
+def write_vtk_file(grid, path: str, scalars: dict | None = None) -> None:
+    """Write all leaf cells as hexahedra (voxel cells), with optional
+    ``{name: per-cell values}`` scalar data appended."""
+    cells = grid.get_cells()
+    mins = grid.geometry.get_min(cells)
+    maxs = grid.geometry.get_max(cells)
+    n = len(cells)
+
+    with open(path, "w") as f:
+        f.write("# vtk DataFile Version 2.0\n")
+        f.write("dccrg_tpu grid\n")
+        f.write("ASCII\nDATASET UNSTRUCTURED_GRID\n")
+        f.write(f"POINTS {8 * n} float\n")
+        for lo, hi in zip(mins, maxs):
+            for z in (lo[2], hi[2]):
+                for y in (lo[1], hi[1]):
+                    for x in (lo[0], hi[0]):
+                        f.write(f"{x} {y} {z}\n")
+        f.write(f"CELLS {n} {9 * n}\n")
+        for i in range(n):
+            pts = " ".join(str(8 * i + k) for k in range(8))
+            f.write(f"8 {pts}\n")
+        f.write(f"CELL_TYPES {n}\n")
+        f.write("\n".join(["11"] * n) + "\n")
+        if scalars:
+            f.write(f"CELL_DATA {n}\n")
+            for name, vals in scalars.items():
+                vals = np.asarray(vals)
+                f.write(f"SCALARS {name} float 1\nLOOKUP_TABLE default\n")
+                f.write("\n".join(str(float(v)) for v in vals) + "\n")

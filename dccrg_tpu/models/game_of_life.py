@@ -66,7 +66,7 @@ class GameOfLife:
             local = tables["local_mask"]
             return {
                 "is_alive": jnp.where(local, new_alive, alive),
-                "live_neighbor_count": jnp.where(local, count, 0),
+                "live_neighbor_count": jnp.where(local, count, jnp.uint32(0)),
             }
 
         return step
