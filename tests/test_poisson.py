@@ -1,0 +1,170 @@
+"""Poisson solver tests vs serial oracles — the reference validates its
+parallel solver against a serial implementation
+(tests/poisson/reference_poisson_solve.hpp); here the oracles are an
+analytic periodic solution and an independently-built dense matrix."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models.poisson import Poisson
+
+
+def make_grid(length, max_ref=0, periodic=(True, True, True), cell_len=None, n_dev=None):
+    n = np.asarray(length)
+    cell_len = cell_len or tuple(1.0 / n)
+    return (
+        Grid()
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(0)
+        .set_periodic(*periodic)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0), level_0_cell_length=cell_len)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+
+
+def dense_matrix_oracle(grid):
+    """Independent construction of the system matrix from the reference's
+    factor formulas (poisson_solve.hpp:691-822), cell by cell."""
+    cells = grid.get_cells()
+    pos = {int(c): i for i, c in enumerate(cells)}
+    n = len(cells)
+    A = np.zeros((n, n))
+    lengths = grid.geometry.get_length(cells)
+    for i, c in enumerate(cells):
+        half = lengths[i] / 2
+        offs = {+1: 2 * half[0], -1: -2 * half[0], +2: 2 * half[1],
+                -2: -2 * half[1], +3: 2 * half[2], -3: -2 * half[2]}
+        present = set()
+        fn = grid.get_face_neighbors_of(int(c))
+        for nid, d in fn:
+            j = pos[int(nid)]
+            nh = lengths[j] / 2
+            ax = abs(d) - 1
+            off = half[ax] + nh[ax]
+            offs[d] = off if d > 0 else -off
+            present.add(d)
+        total = {1: offs[1] - offs[-1], 2: offs[2] - offs[-2], 3: offs[3] - offs[-3]}
+        f = {}
+        for d in (+1, +2, +3):
+            f[d] = 2.0 / (offs[d] * total[d]) if d in present else 0.0
+        for d in (-1, -2, -3):
+            f[d] = -2.0 / (offs[d] * total[-d]) if d in present else 0.0
+        A[i, i] = -sum(f.values())
+        for nid, d in fn:
+            j = pos[int(nid)]
+            m = f[d]
+            if lengths[j][0] < lengths[i][0]:  # finer neighbor
+                m /= 4.0
+            A[i, j] += m
+    return A
+
+
+def test_periodic_1d_analytic():
+    n = 32
+    g = make_grid((n, 1, 1))
+    p = Poisson(g)
+    x = g.geometry.get_center(g.get_cells())[:, 0]
+    k = 2 * np.pi
+    rhs = np.sin(k * x)
+    state = p.initialize_state(rhs)
+    state, res, it = p.solve(state, max_iterations=2000, stop_residual=1e-12)
+    sol = g.get_cell_data(state, "solution", g.get_cells())
+    expect = -np.sin(k * x) / k**2
+    sol = sol - sol.mean() + expect.mean()
+    # second-order accurate on a 32-cell grid
+    np.testing.assert_allclose(sol, expect, atol=2e-3)
+    assert res < 1e-10
+
+
+def test_matches_dense_oracle_uniform():
+    g = make_grid((6, 6, 1), periodic=(True, True, False))
+    p = Poisson(g)
+    rng = np.random.default_rng(9)
+    rhs = rng.standard_normal(36)
+    rhs -= rhs.mean()
+    state = p.initialize_state(rhs)
+    state, res, it = p.solve(state, max_iterations=500, stop_residual=1e-13)
+    sol = g.get_cell_data(state, "solution", g.get_cells())
+
+    A = dense_matrix_oracle(g)
+    want, *_ = np.linalg.lstsq(A, rhs, rcond=None)
+    np.testing.assert_allclose(sol - sol.mean(), want - want.mean(), atol=1e-8)
+
+
+def test_refined_operator_matches_oracle():
+    """On AMR grids the reference's discretization is non-normal and its
+    system can be inconsistent; BiCG then only semi-converges (which the
+    reference handles by keeping the min-residual solution,
+    poisson_solve.hpp:246-250).  So the oracle check is on the OPERATOR:
+    A·v and Aᵀ·v must match the independently built dense matrix exactly."""
+    g = make_grid((4, 4, 1), max_ref=1, periodic=(True, True, False))
+    g.refine_completely(6)
+    g.refine_completely(11)
+    g.stop_refining()
+    p = Poisson(g)
+    cells = g.get_cells()
+    pos = g.leaves.position(cells)
+    dev, row = g.epoch.global_rows(pos)
+    A = dense_matrix_oracle(g)
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        v = rng.standard_normal(len(cells))
+        st = g.new_state(p.spec)
+        st = g.set_cell_data(st, "solution", cells, v)
+        Ax, _ = p._apply(st["solution"], p._mult_fwd)
+        np.testing.assert_allclose(np.asarray(Ax)[dev, row], A @ v, atol=1e-12)
+        ATx, _ = p._apply(st["solution"], p._mult_rev)
+        np.testing.assert_allclose(np.asarray(ATx)[dev, row], A.T @ v, atol=1e-12)
+
+
+def test_refined_solve_reaches_attainable_residual():
+    """The best residual our solver reports must be close to the true
+    attainable minimum (lstsq residual) on a refined grid."""
+    g = make_grid((4, 4, 1), max_ref=1, periodic=(True, True, False))
+    g.refine_completely(6)
+    g.refine_completely(11)
+    g.stop_refining()
+    p = Poisson(g)
+    cells = g.get_cells()
+    rng = np.random.default_rng(1)
+    rhs = rng.standard_normal(len(cells))
+    vol = np.prod(g.geometry.get_length(cells), axis=-1)
+    rhs -= (rhs * vol).sum() / vol.sum()
+    state = p.initialize_state(rhs)
+    state, res, it = p.solve(
+        state, max_iterations=2000, stop_residual=1e-13,
+        stop_after_residual_increase=1e6,
+    )
+
+    # BiCG on this singular non-normal system semi-converges then breaks
+    # down (dot_r -> 0), as the reference's identical algorithm does; the
+    # guarantee is a substantial reduction and an honest best-residual
+    # report, not full convergence (the reference tests count failures
+    # rather than require them to be zero).
+    assert res <= 0.1 * np.linalg.norm(rhs)
+    assert p.residual(state) == pytest.approx(res, rel=1e-6, abs=1e-12)
+
+
+def test_residual_reported():
+    g = make_grid((8, 8, 1), periodic=(True, True, False))
+    p = Poisson(g)
+    rhs = np.zeros(64)
+    rhs[0], rhs[-1] = 1.0, -1.0
+    state = p.initialize_state(rhs)
+    state, res, it = p.solve(state, max_iterations=300, stop_residual=1e-12)
+    assert res <= 1e-10
+    assert p.residual(state) == pytest.approx(res, rel=1e-3, abs=1e-12)
+
+
+def test_device_count_invariance():
+    sols = []
+    for n_dev in (1, 8):
+        g = make_grid((8, 4, 1), periodic=(True, True, False), n_dev=n_dev)
+        p = Poisson(g)
+        x = g.geometry.get_center(g.get_cells())[:, 0]
+        state = p.initialize_state(np.cos(2 * np.pi * x))
+        state, res, it = p.solve(state, max_iterations=500, stop_residual=1e-13)
+        sol = g.get_cell_data(state, "solution", g.get_cells())
+        sols.append(sol - sol.mean())
+    np.testing.assert_allclose(sols[0], sols[1], atol=1e-10)
