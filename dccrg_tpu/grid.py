@@ -520,13 +520,27 @@ class Grid:
         return bool(c) and self.dont_unrefine(c)
 
     def _cell_at(self, coords) -> int:
-        """Existing leaf containing given coordinates (searches from level 0
-        down, reference ``get_existing_cell``)."""
         for lvl in range(self.mapping.max_refinement_level, -1, -1):
             c = self.geometry.get_cell(lvl, np.asarray(coords, dtype=np.float64))
             if int(c) and bool(self.leaves.exists(np.uint64(c))):
                 return int(c)
         return 0
+
+    def get_existing_cell(self, coords) -> np.ndarray:
+        """Existing leaf containing each coordinate (vectorized; 0 for
+        outside) — reference ``get_existing_cell`` (``dccrg.hpp:6316``)."""
+        coords = np.atleast_2d(np.asarray(coords, dtype=np.float64))
+        out = np.zeros(len(coords), dtype=np.uint64)
+        unresolved = np.ones(len(coords), dtype=bool)
+        for lvl in range(self.mapping.max_refinement_level, -1, -1):
+            if not unresolved.any():
+                break
+            ids = self.geometry.get_cell(lvl, coords[unresolved])
+            exists = self.leaves.exists(ids)
+            idx = np.flatnonzero(unresolved)
+            out[idx[exists]] = ids[exists]
+            unresolved[idx[exists]] = False
+        return out
 
     def stop_refining(self, sorted: bool = True) -> np.ndarray:
         """Commit all queued refines/unrefines (veto -> induce -> override
