@@ -129,9 +129,13 @@ class Grid:
         """Recompute every derived structure for the current leaf set —
         the analogue of the reference's post-mutation rebuild tail
         (``dccrg.hpp:4063-4111, 10503-10551``)."""
-        self.epoch = build_epoch(
-            self.mapping, self.topology, self.leaves, self.n_devices, self.neighborhoods
-        )
+        from .utils.timers import timers
+
+        with timers.phase("grid.rebuild_epoch"):
+            self.epoch = build_epoch(
+                self.mapping, self.topology, self.leaves, self.n_devices,
+                self.neighborhoods,
+            )
         self._halo_cache = {}
         self._id_pos_cache = None
 
@@ -277,6 +281,37 @@ class Grid:
     def wait_remote_neighbor_copy_updates(self, state):
         """Split-phase wait: block until ghost rows are materialized."""
         return jax.block_until_ready(state)
+
+    # -------------------------------------------------- user neighborhoods
+
+    def add_neighborhood(self, hood_id: int, offsets) -> bool:
+        """Add a user-defined neighborhood with its own neighbor lists,
+        send/recv schedule and iteration masks (reference
+        ``dccrg.hpp:6383-6555``).  As in the reference, the offsets must fit
+        inside the default neighborhood so ghost requirements (and hence
+        payload layouts) are unchanged; existing states remain valid."""
+        self._assert_initialized()
+        if hood_id in self.neighborhoods or hood_id is None:
+            return False
+        offs = validate_neighborhood(offsets)
+        n = self._hood_length
+        if n == 0:
+            default = {tuple(o) for o in self.neighborhoods[None].tolist()}
+            if not all(tuple(o) in default for o in offs.tolist()):
+                return False
+        else:
+            if np.abs(offs).max() > n:
+                return False
+        self.neighborhoods[hood_id] = offs
+        self._rebuild()
+        return True
+
+    def remove_neighborhood(self, hood_id: int) -> bool:
+        if hood_id is None or hood_id not in self.neighborhoods:
+            return False
+        del self.neighborhoods[hood_id]
+        self._rebuild()
+        return True
 
     # ------------------------------------------------------- load balancing
 

@@ -33,7 +33,26 @@ class StencilTables:
       local_mask / inner_mask / outer_mask  [D, R] bool
     """
 
-    def __init__(self, grid, hood_id=None, with_geometry: bool = False):
+    def __init__(
+        self,
+        grid,
+        hood_id=None,
+        with_geometry: bool = False,
+        cell_items: dict | None = None,
+        neighbor_items: dict | None = None,
+    ):
+        """``cell_items``/``neighbor_items`` are the TPU analogue of the
+        reference's Additional_Cell_Items / Additional_Neighbor_Items
+        mixins (``dccrg.hpp:7288-7402``): named callbacks evaluated at
+        table-build time and shipped as extra device arrays.
+
+        * ``cell_items[name] = fn(grid, cell_ids) -> (N, ...)`` becomes a
+          ``[D, R, ...]`` attribute (e.g. cached cell centers — the
+          advection test's Center mixin, tests/advection/cell.hpp:164-173);
+        * ``neighbor_items[name] = fn(grid, cell_ids, nbr_ids, offsets) ->
+          (E, ...)`` becomes a ``[D, R, K, ...]`` attribute (e.g. neighbor
+          locality — the Is_Local mixin, tests/advection/cell.hpp:153-162).
+        """
         epoch = grid.epoch
         hood = epoch.hoods[hood_id]
         mesh = grid.mesh
@@ -58,6 +77,39 @@ class StencilTables:
             lengths[pad] = 1.0
             self.center = put(centers)
             self.length = put(lengths)
+
+        if cell_items:
+            leaves = epoch.leaves
+            for name, fn in cell_items.items():
+                vals = np.asarray(fn(grid, leaves.cells))
+                out = np.zeros((epoch.n_devices, epoch.R) + vals.shape[1:], vals.dtype)
+                for d in range(epoch.n_devices):
+                    lp, gp = epoch.local_pos[d], epoch.ghost_pos[d]
+                    out[d, : len(lp)] = vals[lp]
+                    out[d, len(lp) : len(lp) + len(gp)] = vals[gp]
+                setattr(self, name, put(out))
+
+        if neighbor_items:
+            leaves = epoch.leaves
+            lists = hood.lists
+            counts = np.diff(lists.start)
+            src = np.repeat(np.arange(len(leaves)), counts)
+            ecol = (
+                np.concatenate([np.arange(c) for c in counts])
+                if len(leaves)
+                else np.zeros(0, int)
+            )
+            owner = leaves.owner.astype(np.int64)
+            D, R, K = hood.nbr_rows.shape
+            for name, fn in neighbor_items.items():
+                vals = np.asarray(
+                    fn(grid, leaves.cells[src], lists.nbr_cell, lists.offset)
+                )
+                out = np.zeros((D, R, K) + vals.shape[1:], vals.dtype)
+                for d in range(D):
+                    sel = owner[src] == d
+                    out[d, grid.epoch.row_of[src[sel]], ecol[sel]] = vals[sel]
+                setattr(self, name, put(out))
 
     def tree(self) -> dict:
         """The tables as a pytree (to pass through jit boundaries)."""

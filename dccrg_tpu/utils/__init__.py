@@ -1,0 +1,4 @@
+from .timers import PhaseTimers, timers
+from .verify import verify_grid, verify_user_data
+
+__all__ = ["PhaseTimers", "timers", "verify_grid", "verify_user_data"]

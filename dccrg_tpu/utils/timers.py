@@ -1,0 +1,53 @@
+"""Lightweight per-phase timers.
+
+The reference has no tracing layer (timing lives in its workloads via
+``chrono``, e.g. examples/game_of_life.cpp:116-146); SURVEY.md flags this
+as a gap to fill.  This registry times named phases (grid rebuilds, halo
+exchanges, solver iterations) with negligible overhead and can hand its
+spans to ``jax.profiler`` traces when deeper inspection is needed.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+__all__ = ["PhaseTimers", "timers"]
+
+
+class PhaseTimers:
+    def __init__(self):
+        self.total = defaultdict(float)
+        self.count = defaultdict(int)
+        self.enabled = True
+
+    @contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.total[name] += dt
+            self.count[name] += 1
+
+    def report(self) -> dict:
+        return {
+            name: {
+                "total_s": round(self.total[name], 6),
+                "count": self.count[name],
+                "mean_s": round(self.total[name] / max(self.count[name], 1), 6),
+            }
+            for name in sorted(self.total)
+        }
+
+    def reset(self):
+        self.total.clear()
+        self.count.clear()
+
+
+#: process-wide default registry
+timers = PhaseTimers()

@@ -1,0 +1,116 @@
+"""User neighborhoods, additional item hooks, invariant checker, timers
+(reference analogues: tests/user_neighborhood, tests/additional_cell_data,
+the DEBUG verification layer)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.parallel.stencil import StencilTables
+from dccrg_tpu.utils import timers, verify_grid, verify_user_data
+
+
+def make_grid(hood=1, length=(6, 6, 1), max_ref=0):
+    n = np.asarray(length)
+    return (
+        Grid()
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(hood)
+        .set_periodic(True, True, False)
+        .set_geometry(
+            CartesianGeometry, start=(0.0, 0.0, 0.0), level_0_cell_length=tuple(1.0 / n)
+        )
+        .initialize(mesh=make_mesh())
+    )
+
+
+def test_add_remove_neighborhood():
+    g = make_grid(hood=1)
+    # face-only sub-neighborhood inside the full 26-cube default
+    faces = [(0, 0, -1), (0, -1, 0), (-1, 0, 0), (1, 0, 0), (0, 1, 0), (0, 0, 1)]
+    # z offsets leave the 6x6x1 non-periodic z grid -> keep xy faces
+    assert g.add_neighborhood(7, [(1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0)])
+    assert 7 in g.epoch.hoods
+    ids, offs = g.get_neighbors_of(8, hood_id=7)
+    assert len(ids) == 4
+    # user hood must be inside the default
+    assert not g.add_neighborhood(8, [(2, 0, 0)])
+    # duplicate id rejected
+    assert not g.add_neighborhood(7, [(1, 0, 0)])
+    # smaller hood -> fewer cells exchanged
+    assert g.epoch.hoods[7].pair_counts.sum() < g.epoch.hoods[None].pair_counts.sum()
+    assert g.remove_neighborhood(7)
+    assert 7 not in g.epoch.hoods
+    assert not g.remove_neighborhood(7)
+
+
+def test_user_hood_exchange_and_states_stay_valid():
+    g = make_grid(hood=1)
+    state = g.new_state({"v": ((), np.float64)})
+    cells = g.get_cells()
+    state = g.set_cell_data(state, "v", cells, cells.astype(np.float64))
+    g.add_neighborhood(3, [(1, 0, 0), (-1, 0, 0)])
+    # the pre-existing state still matches the layout and exchanges fine
+    state = g.update_copies_of_remote_neighbors(state, hood_id=3)
+    verify_grid(g)
+
+
+def test_cell_and_neighbor_item_hooks():
+    g = make_grid(hood=0)
+    tables = StencilTables(
+        g,
+        cell_items={
+            "center": lambda grid, ids: grid.geometry.get_center(ids),
+            "is_edge": lambda grid, ids: (
+                grid.mapping.get_indices(ids)[:, 0] == 0
+            ),
+        },
+        neighbor_items={
+            "nbr_is_local": lambda grid, src, nbr, off: (
+                grid.get_owner(nbr) == grid.get_owner(src)
+            ),
+            "offset_norm": lambda grid, src, nbr, off: np.abs(off).sum(axis=1),
+        },
+    )
+    D, R = g.n_devices, g.epoch.R
+    assert np.asarray(tables.center).shape == (D, R, 3)
+    assert np.asarray(tables.nbr_is_local).shape == np.asarray(tables.nbr_rows).shape
+    # spot check: cell 1's center
+    pos = int(g.leaves.position(np.uint64(1)))
+    d, r = g.leaves.owner[pos], g.epoch.row_of[pos]
+    np.testing.assert_allclose(
+        np.asarray(tables.center)[d, r], g.geometry.get_center(np.uint64(1))
+    )
+    # offsets of face neighbors are one cell apart
+    valid = np.asarray(tables.nbr_valid)
+    norms = np.asarray(tables.offset_norm)
+    assert (norms[valid] == 1).all()
+
+
+def test_verify_grid_passes_and_catches_corruption():
+    g = make_grid(hood=1, max_ref=1)
+    g.refine_completely(8)
+    g.stop_refining()
+    verify_grid(g)
+    # corrupt the directory -> must be caught
+    g.leaves.owner[0] = 99
+    with pytest.raises(AssertionError):
+        verify_grid(g)
+
+
+def test_verify_user_data():
+    g = make_grid(hood=1)
+    spec = {"v": ((), np.float64)}
+    state = g.new_state(spec)
+    cells = g.get_cells()
+    state = g.set_cell_data(state, "v", cells, np.arange(len(cells), dtype=np.float64))
+    verify_user_data(g, state, spec)
+
+
+def test_timers_record_phases():
+    timers.reset()
+    make_grid()
+    rep = timers.report()
+    assert "grid.rebuild_epoch" in rep
+    assert rep["grid.rebuild_epoch"]["count"] >= 1
+    assert rep["grid.rebuild_epoch"]["total_s"] > 0
