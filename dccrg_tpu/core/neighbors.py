@@ -102,6 +102,20 @@ def find_all_neighbors(
     if source_cells is None:
         source_cells = leaves.cells
     src_cells = np.asarray(source_cells, dtype=np.uint64)
+
+    # compiled fast path (identical semantics; numpy below is the source of
+    # truth and fallback — see native/neighbor_kernels.cpp)
+    from ..native import native_find_neighbors
+
+    native = native_find_neighbors(
+        mapping, topology, leaves.cells, np.asarray(hood, dtype=np.int64),
+        src_cells, strict,
+    )
+    if native is not None:
+        start, nbr_cell, nbr_pos, offset, slot = native
+        return NeighborLists(
+            start=start, nbr_pos=nbr_pos, nbr_cell=nbr_cell, offset=offset, slot=slot
+        )
     N, K = len(src_cells), len(hood)
     mrl = mapping.max_refinement_level
 

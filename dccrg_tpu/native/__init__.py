@@ -1,0 +1,118 @@
+"""Native (C++) host-side kernels with transparent numpy fallback.
+
+The library auto-builds ``libneighbor_kernels.so`` from the bundled source
+on first use (g++ is part of the supported toolchain); set
+``DCCRG_TPU_NATIVE=0`` to force the pure-numpy path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+
+__all__ = ["native_find_neighbors", "native_available"]
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_LIB_PATH = _DIR / "libneighbor_kernels.so"
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DCCRG_TPU_NATIVE", "1") == "0":
+        return None
+    src = _DIR / "neighbor_kernels.cpp"
+    try:
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src.stat().st_mtime:
+            subprocess.run(
+                [
+                    "g++", "-O3", "-march=native", "-fopenmp", "-shared",
+                    "-fPIC", "-o", str(_LIB_PATH), str(src),
+                ],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+    lib.find_neighbors.restype = ctypes.c_int
+    lib.find_neighbors.argtypes = [
+        u64p, ctypes.c_int64,            # leaves
+        u64p, ctypes.c_int,              # grid_len, max_ref
+        u8p,                             # periodic
+        i64p, ctypes.c_int64,            # hood
+        u64p, ctypes.c_int64,            # src_cells
+        ctypes.c_int, ctypes.c_int,      # strict, emit
+        i64p,                            # counts
+        i64p,                            # out_start
+        u64p, i64p, i64p, i32p,          # out_nbr, out_pos, out_offset, out_slot
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, strict):
+    """C++ fast path for find_all_neighbors; returns the CSR pieces
+    (start, nbr_cell, nbr_pos, offset, slot) or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_src = len(src_cells)
+    grid_len = np.asarray(mapping.length, dtype=np.uint64)
+    periodic = np.asarray(topology.periodic, dtype=np.uint8)
+    hood = np.ascontiguousarray(hood, dtype=np.int64)
+    leaves_cells = np.ascontiguousarray(leaves_cells, dtype=np.uint64)
+    src_cells = np.ascontiguousarray(src_cells, dtype=np.uint64)
+    counts = np.zeros(n_src, dtype=np.int64)
+    bad_cell = ctypes.c_uint64(0)
+    bad_slot = ctypes.c_int64(0)
+    dummy64 = np.zeros(1, dtype=np.int64)
+    dummyu = np.zeros(1, dtype=np.uint64)
+    dummy32 = np.zeros(1, dtype=np.int32)
+
+    rc = lib.find_neighbors(
+        leaves_cells, len(leaves_cells), grid_len, mapping.max_refinement_level,
+        periodic, hood, len(hood), src_cells, n_src, int(strict), 0,
+        counts, dummy64, dummyu, dummy64, dummy64, dummy32,
+        ctypes.byref(bad_cell), ctypes.byref(bad_slot),
+    )
+    if rc:
+        raise RuntimeError(
+            f"inconsistent grid: no neighbor leaf for cell {bad_cell.value} "
+            f"slot {tuple(hood[bad_slot.value])}"
+        )
+    start = np.zeros(n_src + 1, dtype=np.int64)
+    np.cumsum(counts, out=start[1:])
+    E = int(start[-1])
+    out_nbr = np.zeros(E, dtype=np.uint64)
+    out_pos = np.zeros(E, dtype=np.int64)
+    out_offset = np.zeros((E, 3), dtype=np.int64)
+    out_slot = np.zeros(E, dtype=np.int32)
+    rc = lib.find_neighbors(
+        leaves_cells, len(leaves_cells), grid_len, mapping.max_refinement_level,
+        periodic, hood, len(hood), src_cells, n_src, int(strict), 1,
+        counts, start, out_nbr, out_pos,
+        out_offset.reshape(-1), out_slot,
+        ctypes.byref(bad_cell), ctypes.byref(bad_slot),
+    )
+    if rc:
+        raise RuntimeError(
+            f"neighbor {bad_cell.value} is not an existing leaf (2:1 violation?)"
+        )
+    return start, out_nbr, out_pos, out_offset, out_slot

@@ -1,0 +1,222 @@
+// Native neighbor-list construction: the hot host-side kernel behind
+// find_all_neighbors (core/neighbors.py), whose semantics mirror the
+// reference's find_neighbors_of walk (dccrg.hpp:4339-4680) re-derived as
+// direct index arithmetic + binary search over the sorted leaf directory.
+//
+// The Python/numpy implementation is the semantic source of truth and the
+// fallback; this kernel exists because epoch rebuilds after AMR/load
+// balancing are O(cells * slots) host work — the main scaling risk of the
+// host-orchestrated design — and a compiled, OpenMP-parallel version keeps
+// rebuild cost negligible against device compute.
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC
+//        -o libneighbor_kernels.so neighbor_kernels.cpp
+
+#include <cstdint>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct MappingParams {
+    uint64_t len[3];     // grid length in level-0 cells
+    int max_ref;         // maximum refinement level
+    uint64_t level_offset[32];  // first id of each level block (1-based)
+    uint64_t last_cell;
+};
+
+inline void init_mapping(MappingParams& m) {
+    uint64_t n0 = m.len[0] * m.len[1] * m.len[2];
+    uint64_t off = 1;
+    for (int l = 0; l <= m.max_ref + 1 && l < 32; l++) {
+        m.level_offset[l] = off;
+        off += n0 << (3 * l);
+    }
+    m.last_cell = m.level_offset[m.max_ref + 1] - 1;
+}
+
+inline int refinement_level(const MappingParams& m, uint64_t cell) {
+    if (cell == 0 || cell > m.last_cell) return -1;
+    for (int l = 0; l <= m.max_ref; l++) {
+        if (cell < m.level_offset[l + 1]) return l;
+    }
+    return -1;
+}
+
+// indices at max-refinement resolution (cell min corner)
+inline void get_indices(const MappingParams& m, uint64_t cell, int lvl,
+                        int64_t out[3]) {
+    uint64_t local = cell - m.level_offset[lvl];
+    uint64_t lx = m.len[0] << lvl, ly = m.len[1] << lvl;
+    uint64_t scale = uint64_t(1) << (m.max_ref - lvl);
+    out[0] = int64_t((local % lx) * scale);
+    out[1] = int64_t(((local / lx) % ly) * scale);
+    out[2] = int64_t((local / (lx * ly)) * scale);
+}
+
+inline uint64_t cell_from_indices(const MappingParams& m, const int64_t ind[3],
+                                  int lvl) {
+    uint64_t scale = uint64_t(1) << (m.max_ref - lvl);
+    uint64_t ix = uint64_t(ind[0]) / scale;
+    uint64_t iy = uint64_t(ind[1]) / scale;
+    uint64_t iz = uint64_t(ind[2]) / scale;
+    uint64_t lx = m.len[0] << lvl, ly = m.len[1] << lvl;
+    return m.level_offset[lvl] + ix + iy * lx + iz * lx * ly;
+}
+
+// binary search in sorted leaf array; -1 if absent
+inline int64_t leaf_position(const uint64_t* leaves, int64_t n, uint64_t id) {
+    int64_t lo = 0, hi = n - 1;
+    while (lo <= hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (leaves[mid] < id) lo = mid + 1;
+        else if (leaves[mid] > id) hi = mid - 1;
+        else return mid;
+    }
+    return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Phase 1: count entries per source cell (fills counts[n_src]).
+// Phase 2 (emit != 0): fill CSR outputs; out_start must already hold the
+// exclusive prefix sum of counts (n_src + 1 entries).
+// Returns 0 on success, 1 on inconsistent grid (strict mode), where
+// bad_cell/bad_slot identify the offender.
+int find_neighbors(
+    const uint64_t* leaves, int64_t n_leaves,
+    const uint64_t* grid_len, int max_ref,
+    const uint8_t* periodic,
+    const int64_t* hood, int64_t n_hood,           // (K, 3) flattened
+    const uint64_t* src_cells, int64_t n_src,
+    int strict,
+    int emit,
+    int64_t* counts,                               // n_src
+    const int64_t* out_start,                      // n_src + 1 (phase 2)
+    uint64_t* out_nbr,                             // E
+    int64_t* out_pos,                              // E
+    int64_t* out_offset,                           // (E, 3) flattened
+    int32_t* out_slot,                             // E
+    uint64_t* bad_cell, int64_t* bad_slot
+) {
+    MappingParams m;
+    m.len[0] = grid_len[0]; m.len[1] = grid_len[1]; m.len[2] = grid_len[2];
+    m.max_ref = max_ref;
+    init_mapping(m);
+
+    const int64_t L[3] = {
+        int64_t(m.len[0]) << max_ref,
+        int64_t(m.len[1]) << max_ref,
+        int64_t(m.len[2]) << max_ref,
+    };
+
+    int error = 0;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n_src; i++) {
+        if (error) continue;
+        const uint64_t cell = src_cells[i];
+        const int lvl = refinement_level(m, cell);
+        int64_t idx[3];
+        get_indices(m, cell, lvl, idx);
+        const int64_t s = int64_t(1) << (max_ref - lvl);
+
+        int64_t n_entries = 0;
+        int64_t cursor = emit ? out_start[i] : 0;
+
+        for (int64_t k = 0; k < n_hood; k++) {
+            int64_t t[3], t_mod[3];
+            bool valid = true;
+            for (int d = 0; d < 3; d++) {
+                t[d] = idx[d] + hood[3 * k + d] * s;
+                if (t[d] < 0 || t[d] >= L[d]) {
+                    if (!periodic[d]) { valid = false; break; }
+                }
+                int64_t w = t[d] % L[d];
+                t_mod[d] = w < 0 ? w + L[d] : w;
+            }
+            if (!valid) continue;
+
+            // same level?
+            uint64_t cand = cell_from_indices(m, t_mod, lvl);
+            int64_t pos = leaf_position(leaves, n_leaves, cand);
+            if (pos >= 0) {
+                n_entries += 1;
+                if (emit) {
+                    out_nbr[cursor] = cand;
+                    out_pos[cursor] = pos;
+                    for (int d = 0; d < 3; d++)
+                        out_offset[3 * cursor + d] = hood[3 * k + d] * s;
+                    out_slot[cursor] = int32_t(k);
+                    cursor++;
+                }
+                continue;
+            }
+            // coarser?
+            if (lvl > 0) {
+                uint64_t coarse = cell_from_indices(m, t_mod, lvl - 1);
+                int64_t cpos = leaf_position(leaves, n_leaves, coarse);
+                if (cpos >= 0) {
+                    n_entries += 1;
+                    if (emit) {
+                        int64_t c_ind[3];
+                        get_indices(m, coarse, lvl - 1, c_ind);
+                        out_nbr[cursor] = coarse;
+                        out_pos[cursor] = cpos;
+                        for (int d = 0; d < 3; d++)
+                            out_offset[3 * cursor + d] =
+                                hood[3 * k + d] * s - (t_mod[d] - c_ind[d]);
+                        out_slot[cursor] = int32_t(k);
+                        cursor++;
+                    }
+                    continue;
+                }
+            }
+            // finer: all 8 children of the slot's same-level candidate
+            if (lvl < max_ref) {
+                n_entries += 8;
+                if (emit) {
+                    const int64_t half = s >> 1;
+                    int sib = 0;
+                    for (int dz = 0; dz < 2; dz++)
+                    for (int dy = 0; dy < 2; dy++)
+                    for (int dx = 0; dx < 2; dx++, sib++) {
+                        int64_t ci[3] = {
+                            t_mod[0] + dx * half,
+                            t_mod[1] + dy * half,
+                            t_mod[2] + dz * half,
+                        };
+                        uint64_t child = cell_from_indices(m, ci, lvl + 1);
+                        int64_t ppos = leaf_position(leaves, n_leaves, child);
+                        if (ppos < 0 && strict) {
+#pragma omp critical
+                            { error = 1; *bad_cell = cell; *bad_slot = k; }
+                        }
+                        out_nbr[cursor] = child;
+                        out_pos[cursor] = ppos;
+                        out_offset[3 * cursor + 0] = hood[3 * k + 0] * s + dx * half;
+                        out_offset[3 * cursor + 1] = hood[3 * k + 1] * s + dy * half;
+                        out_offset[3 * cursor + 2] = hood[3 * k + 2] * s + dz * half;
+                        out_slot[cursor] = int32_t(k);
+                        cursor++;
+                    }
+                }
+                continue;
+            }
+            // unresolved slot
+            if (strict) {
+#pragma omp critical
+                { error = 1; *bad_cell = cell; *bad_slot = k; }
+            }
+        }
+        counts[i] = n_entries;
+    }
+    return error;
+}
+
+}  // extern "C"

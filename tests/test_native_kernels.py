@@ -1,0 +1,57 @@
+"""Native C++ neighbor kernel vs the numpy reference implementation."""
+import numpy as np
+import pytest
+
+from dccrg_tpu.core import Mapping, Topology
+from dccrg_tpu.core.neighborhood import default_neighborhood
+from dccrg_tpu.core.neighbors import LeafSet, find_all_neighbors
+from dccrg_tpu.native import native_available, native_find_neighbors
+
+from test_neighbors import make_leafset
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native kernels not built"
+)
+
+
+@pytest.mark.parametrize("periodic", [(False,) * 3, (True, False, True)])
+@pytest.mark.parametrize("hood_len", [0, 1, 2])
+@pytest.mark.parametrize("refine", [[], [14], [1, 14, 27]])
+def test_native_matches_numpy(periodic, hood_len, refine):
+    m = Mapping(length=(3, 3, 3), max_refinement_level=2)
+    t = Topology(periodic=periodic)
+    leaves = make_leafset(m, refine_cells=refine)
+    hood = default_neighborhood(hood_len)
+
+    nat = native_find_neighbors(m, t, leaves.cells, hood, leaves.cells, True)
+    assert nat is not None
+    start, nbr_cell, nbr_pos, offset, slot = nat
+
+    import os
+
+    os.environ["DCCRG_TPU_NATIVE"] = "0"
+    try:
+        import dccrg_tpu.native as native_mod
+
+        native_mod._tried, native_mod._lib = True, None
+        ref = find_all_neighbors(m, t, leaves, hood)
+    finally:
+        del os.environ["DCCRG_TPU_NATIVE"]
+        native_mod._tried = False
+
+    np.testing.assert_array_equal(start, ref.start)
+    np.testing.assert_array_equal(nbr_cell, ref.nbr_cell)
+    np.testing.assert_array_equal(nbr_pos, ref.nbr_pos)
+    np.testing.assert_array_equal(offset, ref.offset)
+    np.testing.assert_array_equal(slot, ref.slot)
+
+
+def test_native_strict_error():
+    m = Mapping(length=(2, 1, 1), max_refinement_level=2)
+    t = Topology()
+    # broken leaf set: cell 1 missing entirely
+    leaves = LeafSet(
+        cells=np.array([2], dtype=np.uint64), owner=np.zeros(1, dtype=np.int32)
+    )
+    with pytest.raises(RuntimeError, match="no neighbor leaf|not an existing leaf"):
+        find_all_neighbors(m, t, leaves, default_neighborhood(0))
