@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Scalability sweep: throughput vs device count — the analogue of the
+reference's tests/scalability family and its sweep driver
+(tests/scalability/run_tests.py:27-39), which runs ``mpirun -np N`` for a
+range of N.  Here N is a virtual CPU device count (the same mechanism the
+test suite uses) unless run on a real multi-chip mesh.
+
+Usage: python benchmarks/scalability.py [gol|advection] [--devices 1 2 4 8]
+"""
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def run_sweep(workload: str, counts, size: int, turns: int):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(counts)}"
+        ).strip()
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Advection, GameOfLife
+
+    results = []
+    for n_dev in counts:
+        mesh = make_mesh(n_devices=n_dev)
+        if workload == "gol":
+            grid = (
+                Grid()
+                .set_initial_length((size, size, 1))
+                .set_neighborhood_length(1)
+                .initialize(mesh=mesh)
+            )
+            gol = GameOfLife(grid)
+            rng = np.random.default_rng(0)
+            cells = grid.get_cells()
+            state = gol.new_state(alive_cells=cells[rng.random(len(cells)) < 0.3])
+            jax.block_until_ready(gol.run(state, 2))
+            t0 = time.perf_counter()
+            state = gol.run(state, turns)
+            jax.block_until_ready(state)
+            secs = time.perf_counter() - t0
+            n_cells = size * size
+        else:
+            grid = (
+                Grid()
+                .set_initial_length((size, size, n_dev))
+                .set_neighborhood_length(0)
+                .set_periodic(True, True, True)
+                .set_geometry(
+                    CartesianGeometry,
+                    start=(0.0, 0.0, 0.0),
+                    level_0_cell_length=(1.0 / size, 1.0 / size, 1.0 / n_dev),
+                )
+                .initialize(mesh=mesh)
+            )
+            adv = Advection(grid, dtype=np.float32)
+            state = adv.initialize_state()
+            dt = np.float32(0.4 * adv.max_time_step(state))
+            jax.block_until_ready(adv.run(state, 2, dt))
+            t0 = time.perf_counter()
+            state = adv.run(state, turns, dt)
+            jax.block_until_ready(state)
+            secs = time.perf_counter() - t0
+            n_cells = size * size * n_dev
+        row = {
+            "devices": n_dev,
+            "cells": n_cells,
+            "turns": turns,
+            "secs": round(secs, 4),
+            "cell_updates_per_s": round(n_cells * turns / secs, 1),
+            "per_device_per_s": round(n_cells * turns / secs / n_dev, 1),
+        }
+        results.append(row)
+        print(json.dumps(row))
+    return results
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workload", nargs="?", default="gol", choices=["gol", "advection"])
+    ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--turns", type=int, default=20)
+    a = ap.parse_args()
+    run_sweep(a.workload, a.devices, a.size, a.turns)

@@ -30,11 +30,23 @@ from .parallel.halo import HaloExchange
 from .parallel.mesh import SHARD_AXIS, make_mesh, shard_spec
 from .parallel.partition import block_partition, morton_partition
 
-__all__ = ["Grid", "CellSpec"]
+__all__ = ["Grid", "CellSpec", "HAS_NO_NEIGHBOR", "HAS_LOCAL_NEIGHBOR_OF",
+           "HAS_LOCAL_NEIGHBOR_TO", "HAS_REMOTE_NEIGHBOR_OF",
+           "HAS_REMOTE_NEIGHBOR_TO"]
 
 #: field name -> (per-cell shape tuple, dtype); the pytree/dtype analogue of
 #: the reference's MPI datatype seam.
 CellSpec = dict
+
+#: neighbor-relation criteria bits for ``Grid.get_cells_by_criteria``
+#: (reference ``dccrg.hpp:85-142``)
+HAS_NO_NEIGHBOR = 0
+HAS_LOCAL_NEIGHBOR_OF = 1 << 0
+HAS_LOCAL_NEIGHBOR_TO = 1 << 1
+HAS_REMOTE_NEIGHBOR_OF = 1 << 2
+HAS_REMOTE_NEIGHBOR_TO = 1 << 3
+HAS_LOCAL_NEIGHBOR_BOTH = HAS_LOCAL_NEIGHBOR_OF | HAS_LOCAL_NEIGHBOR_TO
+HAS_REMOTE_NEIGHBOR_BOTH = HAS_REMOTE_NEIGHBOR_OF | HAS_REMOTE_NEIGHBOR_TO
 
 
 class Grid:
@@ -215,6 +227,94 @@ class Grid:
 
     def get_refinement_level(self, cell) -> int:
         return int(self.mapping.get_refinement_level(np.uint64(cell)))
+
+    def neighbor_criteria(self, device: int, hood_id=None) -> np.ndarray:
+        """Bitmask of neighbor-relation criteria per local cell of a device
+        (reference bits, ``dccrg.hpp:85-142``)."""
+        h = self.epoch.hoods[hood_id]
+        lists = h.lists
+        owner = self.leaves.owner.astype(np.int64)
+        N = len(self.leaves)
+        counts = np.diff(lists.start)
+        src = np.repeat(np.arange(N), counts)
+        bits = np.zeros(N, dtype=np.int32)
+        local_nbr = owner[lists.nbr_pos] == owner[src]
+        np.bitwise_or.at(bits, src[local_nbr], HAS_LOCAL_NEIGHBOR_OF)
+        np.bitwise_or.at(bits, src[~local_nbr], HAS_REMOTE_NEIGHBOR_OF)
+        src_to = np.repeat(np.arange(N), np.diff(h.to_start))
+        local_to = owner[h.to_src] == owner[src_to]
+        np.bitwise_or.at(bits, src_to[local_to], HAS_LOCAL_NEIGHBOR_TO)
+        np.bitwise_or.at(bits, src_to[~local_to], HAS_REMOTE_NEIGHBOR_TO)
+        return bits[self.epoch.local_pos[device]]
+
+    def get_cells_by_criteria(
+        self, device: int, criteria: int, exact_match: bool = False, hood_id=None
+    ) -> np.ndarray:
+        """Local cells of a device filtered by neighbor-relation criteria
+        bits (reference ``get_cells``, ``dccrg.hpp:651-741, 2946-3053``):
+        any-bit match by default, all-and-only with ``exact_match``."""
+        bits = self.neighbor_criteria(device, hood_id)
+        cells = self.local_cells(device)
+        if criteria == HAS_NO_NEIGHBOR:
+            return cells[bits == 0]
+        if exact_match:
+            return cells[bits == criteria]
+        return cells[(bits & criteria) != 0]
+
+    # ------------------------------------------------ structure sharing
+
+    def copy_structure(self) -> "Grid":
+        """A new Grid sharing this grid's decomposition (mapping, topology,
+        geometry, leaf set, epoch) but no payload — the analogue of the
+        reference's cross-instantiation copy constructor used to hold a
+        second payload aligned with the same decomposition
+        (``dccrg.hpp:338-438``).  Payloads are separate by construction
+        here (states are user-held pytrees), so the copy can even share the
+        derived epoch until either grid mutates."""
+        g = Grid.__new__(Grid)
+        g.__dict__.update(self.__dict__)
+        g.cell_weights = dict(self.cell_weights)
+        g.pin_requests = dict(self.pin_requests)
+        from .amr.refinement import AmrQueues
+
+        g.amr = AmrQueues()
+        g._halo_cache = dict(self._halo_cache)
+        return g
+
+    # -------------------------------------------------- options / getters
+
+    def set_partitioning_option(self, name: str, value) -> "Grid":
+        """Record a partitioner option (the reference forwards these as
+        Zoltan strings, ``dccrg.hpp:5537-5798``; the native partitioners
+        currently honor none but keep them introspectable)."""
+        if not hasattr(self, "_partitioning_options"):
+            self._partitioning_options = {}
+        self._partitioning_options[str(name)] = value
+        return self
+
+    def get_partitioning_options(self) -> dict:
+        return dict(getattr(self, "_partitioning_options", {}))
+
+    def get_maximum_refinement_level(self) -> int:
+        return self.mapping.max_refinement_level
+
+    def get_neighborhood_length(self) -> int:
+        return self._hood_length
+
+    def get_load_balancing_method(self) -> str:
+        return self._lb_method
+
+    def get_periodicity(self) -> tuple:
+        return self.topology.periodic
+
+    def get_total_cells(self) -> int:
+        return len(self.leaves)
+
+    def get_local_cell_count(self, device: int) -> int:
+        return int(self.epoch.n_local[device])
+
+    def get_ghost_cell_count(self, device: int) -> int:
+        return int(self.epoch.n_ghost[device])
 
     @property
     def length(self):

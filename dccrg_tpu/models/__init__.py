@@ -2,3 +2,8 @@ from .advection import Advection
 from .game_of_life import GameOfLife
 
 __all__ = ["Advection", "GameOfLife"]
+from .particles import Particles
+from .poisson import Poisson
+from .vlasov import Vlasov
+
+__all__ += ["Particles", "Poisson", "Vlasov"]

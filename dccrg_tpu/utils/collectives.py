@@ -1,0 +1,42 @@
+"""Reduction utilities — the role of the reference's MPI support layer
+(``dccrg_mpi_support.hpp``: ``All_Gather`` ``:98-231``, ``All_Reduce``
+``:237-266``, ``Some_Reduce`` ``:282-377``).
+
+Device-wide reductions belong in jitted code (``jnp.sum``/``jnp.min`` over
+sharded arrays lower to XLA collectives over ICI); these helpers cover the
+host-side metadata reductions the reference does between ranks.  Under a
+single controller an "All_Gather" is trivially the array itself — kept as a
+named function so call sites document intent and a future multi-controller
+backend (jax.distributed) has one seam to fill.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["all_gather", "all_reduce", "some_reduce", "halo_peers"]
+
+
+def all_gather(per_device_values) -> list:
+    """Every device's value, visible everywhere (reference All_Gather)."""
+    return list(per_device_values)
+
+
+def all_reduce(per_device_values, op=np.add):
+    """Reduce all devices' values to one result (reference All_Reduce)."""
+    return op.reduce(np.asarray(per_device_values), axis=0)
+
+
+def halo_peers(grid, device: int, hood_id=None) -> np.ndarray:
+    """Devices that exchange halo cells with the given one."""
+    pc = grid.epoch.hoods[hood_id].pair_counts
+    return np.flatnonzero((pc[device] > 0) | (pc[:, device] > 0))
+
+
+def some_reduce(grid, per_device_values, device: int, op=np.add, hood_id=None):
+    """Reduce only among a device and its halo peers — the reference's
+    neighbor-only point-to-point reduce (``Some_Reduce``), whose peer set
+    here comes from the halo schedule instead of explicit rank lists."""
+    peers = halo_peers(grid, device, hood_id)
+    vals = np.asarray(per_device_values)
+    members = np.unique(np.concatenate([[device], peers]))
+    return op.reduce(vals[members], axis=0)

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Convert a .dc checkpoint to a VTK file — the analogue of the
+reference's examples/dc2vtk.cpp (VisIt/ParaView workflow,
+examples/README:20-35).
+
+The payload spec is given on the command line as name:dtype[:shape] items,
+e.g.  ``dc2vtk.py run.dc out.vtk density:f8 mom:f8:3``.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from dccrg_tpu import Grid
+
+
+def parse_spec(items):
+    spec = {}
+    for item in items:
+        parts = item.split(":")
+        name, dtype = parts[0], np.dtype(parts[1])
+        shape = tuple(int(v) for v in parts[2:])
+        spec[name] = (shape, dtype)
+    return spec
+
+
+def main():
+    if len(sys.argv) < 4:
+        sys.exit(__doc__)
+    src, dst = sys.argv[1], sys.argv[2]
+    spec = parse_spec(sys.argv[3:])
+    grid, state, header = Grid.load_grid_data(src, spec, n_devices=1)
+    cells = grid.get_cells()
+    scalars = {}
+    for name, (shape, _) in spec.items():
+        vals = grid.get_cell_data(state, name, cells)
+        if shape == ():
+            scalars[name] = vals
+        else:
+            flat = vals.reshape(len(cells), -1)
+            for i in range(flat.shape[1]):
+                scalars[f"{name}_{i}"] = flat[:, i]
+    grid.write_vtk_file(dst, scalars=scalars)
+    print(f"wrote {dst}: {len(cells)} cells, fields {list(scalars)}")
+
+
+if __name__ == "__main__":
+    main()
