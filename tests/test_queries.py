@@ -1,0 +1,92 @@
+"""Query/getter surface tests (reference tests/get_cells, constructors,
+mpi_support analogues)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+from dccrg_tpu.grid import (
+    HAS_LOCAL_NEIGHBOR_OF,
+    HAS_LOCAL_NEIGHBOR_TO,
+    HAS_REMOTE_NEIGHBOR_OF,
+    HAS_REMOTE_NEIGHBOR_TO,
+)
+from dccrg_tpu.utils.collectives import all_reduce, halo_peers, some_reduce
+
+
+@pytest.fixture
+def grid():
+    return (
+        Grid()
+        .set_initial_length((8, 8, 1))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh())
+    )
+
+
+def test_criteria_bitmask(grid):
+    for d in range(grid.n_devices):
+        local = set(grid.local_cells(d).tolist())
+        inner = set(grid.inner_cells(d).tolist())
+        outer = set(grid.outer_cells(d).tolist())
+        with_remote = set(
+            grid.get_cells_by_criteria(
+                d, HAS_REMOTE_NEIGHBOR_OF | HAS_REMOTE_NEIGHBOR_TO
+            ).tolist()
+        )
+        assert with_remote == outer
+        with_local = set(
+            grid.get_cells_by_criteria(
+                d, HAS_LOCAL_NEIGHBOR_OF | HAS_LOCAL_NEIGHBOR_TO
+            ).tolist()
+        )
+        assert with_local <= local
+        # every cell in this grid has some neighbor
+        assert not len(grid.get_cells_by_criteria(d, 0))
+
+
+def test_exact_match(grid):
+    d = 0
+    # cells matching exactly local-of+local-to and nothing else = inner
+    bits = HAS_LOCAL_NEIGHBOR_OF | HAS_LOCAL_NEIGHBOR_TO
+    exact = set(grid.get_cells_by_criteria(d, bits, exact_match=True).tolist())
+    assert exact == set(grid.inner_cells(d).tolist())
+
+
+def test_getters(grid):
+    assert grid.get_maximum_refinement_level() == 0
+    assert grid.get_neighborhood_length() == 1
+    assert grid.get_load_balancing_method() == "RCB"
+    assert grid.get_periodicity() == (False, False, False)
+    assert grid.get_total_cells() == 64
+    assert sum(grid.get_local_cell_count(d) for d in range(8)) == 64
+    assert grid.get_ghost_cell_count(0) > 0
+    grid.set_partitioning_option("IMBALANCE_TOL", "1.05")
+    assert grid.get_partitioning_options() == {"IMBALANCE_TOL": "1.05"}
+
+
+def test_copy_structure(grid):
+    g2 = grid.copy_structure()
+    np.testing.assert_array_equal(g2.get_cells(), grid.get_cells())
+    assert g2.epoch is grid.epoch
+    # second payload aligned with the same decomposition
+    s1 = grid.new_state({"a": ((), np.float64)})
+    s2 = g2.new_state({"b": ((2,), np.int32)})
+    assert np.asarray(s2["b"]).shape[:2] == np.asarray(s1["a"]).shape[:2]
+    # mutating the copy (rebalance) does not disturb the original
+    g2.pin(1, 7)
+    g2.balance_load()
+    assert int(g2.get_owner(np.uint64(1))) == 7
+    assert int(grid.get_owner(np.uint64(1))) == 0
+    np.testing.assert_array_equal(g2.get_cells(), grid.get_cells())
+
+
+def test_collectives(grid):
+    vals = np.arange(grid.n_devices, dtype=float)
+    assert all_reduce(vals) == vals.sum()
+    peers = halo_peers(grid, 3)
+    assert 2 in peers and 4 in peers
+    # neighbor-only reduce covers the device and its peers only
+    got = some_reduce(grid, vals, 3, op=np.add)
+    expect = vals[np.unique(np.concatenate([[3], peers]))].sum()
+    assert got == expect
+    assert got < vals.sum()
