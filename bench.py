@@ -25,7 +25,7 @@ ROOT = pathlib.Path(__file__).resolve().parent
 # benchmark configuration: 3-D advection, f32 on accelerator (the reference
 # is f64-on-CPU; f32 is the TPU-native precision choice and is recorded)
 NX, NY, NZ = 128, 128, 64
-STEPS = 500
+STEPS = 5000
 
 
 def measure_tpu() -> dict:

@@ -40,10 +40,12 @@ class Advection:
         "max_diff": ((), np.float64),
     }
 
-    def __init__(self, grid, hood_id=None, dtype=np.float64, allow_dense=True):
+    def __init__(self, grid, hood_id=None, dtype=np.float64, allow_dense=True,
+                 use_pallas=True):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
+        self.use_pallas = use_pallas
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
         self.dense = grid.epoch.dense if allow_dense else None
         if self.dense is not None:
@@ -273,6 +275,16 @@ class Advection:
             up = jnp.where(v_face >= 0, rho_c, rho_n)
             return up * dt * v_face * area_d
 
+        # Optional fused Pallas kernel (TPU + f32): same update, one VMEM
+        # pass per z-slab instead of XLA-materialized rolls
+        from ..ops.dense_advection import make_flux_update, pallas_available
+
+        pallas_update = None
+        if getattr(self, "use_pallas", True) and pallas_available(dtype):
+            pallas_update = make_flux_update(nzl, ny, nx, area, 1.0 / vol)
+            mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
+            my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
+
         # Negative-side x/y faces: the flux through cell i's negative face
         # equals the positive-side face flux of cell i-1, i.e.
         # jnp.roll(f, 1, axis) — the boundary mask is already baked into f.
@@ -285,6 +297,13 @@ class Advection:
             mz_dn = zf_dn[0][:, None, None]
             rho_e = extend(rho)
             vz_e = extend(vz)
+
+            if pallas_update is not None:
+                new_rho = pallas_update(
+                    rho_e, vx, vy, vz_e, mx3, my3,
+                    zf_up[0].reshape(nzl, 1, 1), zf_dn[0].reshape(nzl, 1, 1), dt,
+                )
+                return (new_rho[None],)
 
             fx = face_flux(rho, jnp.roll(rho, -1, 2), vx, jnp.roll(vx, -1, 2), area[0], dt) * mx
             fy = face_flux(rho, jnp.roll(rho, -1, 1), vy, jnp.roll(vy, -1, 1), area[1], dt) * my

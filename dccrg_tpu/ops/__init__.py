@@ -1,0 +1,3 @@
+from .dense_advection import pallas_available, make_flux_update
+
+__all__ = ["pallas_available", "make_flux_update"]
