@@ -51,3 +51,17 @@ class PhaseTimers:
 
 #: process-wide default registry
 timers = PhaseTimers()
+
+
+@contextmanager
+def jax_trace(log_dir: str):
+    """Capture a jax.profiler trace around a region (view with
+    TensorBoard / xprof) — the deep-inspection hook SURVEY.md §5 calls for
+    on top of the phase timers."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

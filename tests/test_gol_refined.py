@@ -1,0 +1,81 @@
+"""Game of life on statically refined grids (reference
+tests/game_of_life/refined2d.cpp, unrefined2d.cpp: life on AMR'd grids
+with patterns placed away from refinement boundaries) and with the
+reference's hierarchical/pinned variants combined."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+from dccrg_tpu.models import GameOfLife
+
+
+def make_refined(refine_at, n_dev=None):
+    g = (
+        Grid()
+        .set_initial_length((10, 10, 1))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    for c in refine_at:
+        g.refine_completely(c)
+    g.stop_refining()
+    return g
+
+
+def test_blinker_away_from_refinement():
+    """Refine a corner; a blinker far from it behaves exactly as on the
+    uniform grid (the refined2d test's design)."""
+    g = make_refined([1])  # refine corner cell 1
+    gol = GameOfLife(g)
+    state = gol.new_state(alive_cells=[54, 55, 56])
+    for turn in range(1, 11):
+        state = gol.step(state)
+        alive = set(gol.alive_cells(state).tolist())
+        expect = {45, 55, 65} if turn % 2 == 1 else {54, 55, 56}
+        assert alive == expect, f"turn {turn}"
+
+
+def test_refined_structure_consistent_after_life():
+    g = make_refined([1, 34, 67])
+    gol = GameOfLife(g)
+    rng = np.random.default_rng(2)
+    cells = g.get_cells()
+    state = gol.new_state(alive_cells=cells[rng.random(len(cells)) < 0.3])
+    state = gol.run(state, 5)
+    # counts stay within neighbor-count bounds; no NaN/garbage
+    counts = g.get_cell_data(state, "live_neighbor_count", cells)
+    h = g.epoch.hoods[None]
+    max_entries = np.diff(h.lists.start).max()
+    assert counts.max() <= max_entries
+    from dccrg_tpu.utils import verify_grid
+
+    verify_grid(g)
+
+
+def test_refined_gol_device_invariance():
+    finals = []
+    for n_dev in (1, 8):
+        g = make_refined([1, 55], n_dev=n_dev)
+        gol = GameOfLife(g)
+        cells = g.get_cells()
+        rng = np.random.default_rng(7)
+        alive0 = cells[rng.random(len(cells)) < 0.3]
+        state = gol.new_state(alive_cells=alive0)
+        state = gol.run(state, 8)
+        finals.append(frozenset(gol.alive_cells(state).tolist()))
+    assert finals[0] == finals[1]
+
+
+def test_unrefined_gol():
+    """Refine then unrefine back (unrefined2d analogue): behavior must
+    match the never-refined grid."""
+    g = make_refined([28])
+    children = g.mapping.get_all_children(np.uint64(28))
+    g.unrefine_completely(int(children[0]))
+    g.stop_refining()
+    assert len(g.get_cells()) == 100
+    gol = GameOfLife(g)
+    state = gol.new_state(alive_cells=[54, 55, 56])
+    state = gol.run(state, 4)
+    assert set(gol.alive_cells(state).tolist()) == {54, 55, 56}
