@@ -776,21 +776,35 @@ class Grid:
 
     # ------------------------------------------------------------------- IO
 
-    def save_grid_data(self, state, path: str, spec, user_header: bytes = b""):
+    def save_grid_data(self, state, path: str, spec, user_header: bytes = b"",
+                       ragged=None):
         """Checkpoint grid structure + payloads (reference
-        ``save_grid_data``, ``dccrg.hpp:1089-1716``)."""
+        ``save_grid_data``, ``dccrg.hpp:1089-1716``).  ``ragged`` maps a
+        variable-size field to its count field: only ``count[i]`` rows are
+        written per cell."""
         from .io.checkpoint import save_grid_data as _save
 
-        _save(self, state, path, spec, user_header)
+        _save(self, state, path, spec, user_header, ragged=ragged)
 
     @staticmethod
-    def load_grid_data(path: str, spec, mesh=None, n_devices=None):
+    def load_grid_data(path: str, spec, mesh=None, n_devices=None, ragged=None):
         """Recreate a saved grid on the current devices; any device count
         works (reference ``load_grid_data``, ``dccrg.hpp:1742-2404``).
         Returns (grid, state, user_header)."""
         from .io.checkpoint import load_grid_data as _load
 
-        return _load(path, spec, mesh=mesh, n_devices=n_devices)
+        return _load(path, spec, ragged=ragged, mesh=mesh, n_devices=n_devices)
+
+    @staticmethod
+    def start_loading_grid_data(path: str, spec, mesh=None, n_devices=None,
+                                ragged=None):
+        """Chunked load: returns a loader; call
+        ``loader.continue_loading_grid_data(max_cells)`` until it returns
+        False, then ``loader.finish_loading_grid_data()`` (reference
+        ``dccrg.hpp:1742-2404``)."""
+        from .io.checkpoint import start_loading_grid_data as _start
+
+        return _start(path, spec, ragged=ragged, mesh=mesh, n_devices=n_devices)
 
     def write_vtk_file(self, path: str, scalars: dict | None = None):
         """Dump leaf-cell geometry (+ optional scalars) as legacy ASCII VTK

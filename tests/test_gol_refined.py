@@ -3,7 +3,6 @@ tests/game_of_life/refined2d.cpp, unrefined2d.cpp: life on AMR'd grids
 with patterns placed away from refinement boundaries) and with the
 reference's hierarchical/pinned variants combined."""
 import numpy as np
-import pytest
 
 from dccrg_tpu import Grid, make_mesh
 from dccrg_tpu.models import GameOfLife

@@ -91,3 +91,74 @@ def test_vtk_writer(tmp_path):
     n = len(g.get_cells())
     assert f"CELLS {n} {9*n}" in text
     assert "SCALARS rho" in text
+
+
+def test_variable_size_payload_roundtrip(tmp_path):
+    """Ragged fields store only count[i] rows per cell (reference:
+    variable cell data in files, tests/restart/IO.hpp)."""
+    from dccrg_tpu.models import Particles
+
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, False)
+        .set_geometry(
+            CartesianGeometry, start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(0.25, 0.25, 1.0),
+        )
+        .initialize(mesh=make_mesh(n_devices=4))
+    )
+    p = Particles(g, max_particles_per_cell=8)
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(0.01, 0.99, size=(37, 3)) * [1.0, 1.0, 1.0]
+    state = p.new_state(pts)
+    spec, ragged = p.spec(), {"particles": "number_of_particles"}
+
+    path = tmp_path / "ragged.dc"
+    g.save_grid_data(state, str(path), spec, ragged=ragged)
+
+    # a ragged file must be smaller than the padded-full one
+    path_full = tmp_path / "full.dc"
+    g.save_grid_data(state, str(path_full), spec)
+    assert path.stat().st_size < path_full.stat().st_size
+
+    for n_dev in (2, 8):
+        g2, s2, _ = Grid.load_grid_data(
+            str(path), spec, ragged=ragged, mesh=make_mesh(n_devices=n_dev)
+        )
+        p2 = Particles(g2, max_particles_per_cell=8)
+        got = np.sort(p2.positions(s2).view("f8,f8,f8"), axis=0)
+        want = np.sort(p.positions(state).view("f8,f8,f8"), axis=0)
+        np.testing.assert_array_equal(got, want)
+        for c in g.get_cells():
+            np.testing.assert_array_equal(
+                np.sort(p2.particles_of(s2, c), axis=0),
+                np.sort(p.particles_of(state, c), axis=0),
+            )
+
+
+def test_chunked_loading(tmp_path):
+    """start_/continue_/finish_loading_grid_data parity
+    (dccrg.hpp:2085-2368): payloads arrive over repeated calls."""
+    g = (
+        Grid()
+        .set_initial_length((6, 6, 1))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh())
+    )
+    spec = {"v": ((2,), np.float64)}
+    cells = g.get_cells()
+    vals = np.arange(2 * len(cells), dtype=np.float64).reshape(len(cells), 2)
+    state = g.set_cell_data(g.new_state(spec), "v", cells, vals)
+    path = tmp_path / "chunk.dc"
+    g.save_grid_data(state, str(path), spec, user_header=b"chunked")
+
+    loader = Grid.start_loading_grid_data(str(path), spec, mesh=make_mesh(n_devices=3))
+    n_calls = 0
+    while loader.continue_loading_grid_data(max_cells=7):
+        n_calls += 1
+    g2, s2, hdr = loader.finish_loading_grid_data()
+    assert n_calls >= 5  # 36 cells / 7 per chunk
+    assert hdr == b"chunked"
+    np.testing.assert_array_equal(g2.get_cell_data(s2, "v", cells), vals)

@@ -1,7 +1,6 @@
 """End-to-end workloads on a stretched Cartesian geometry (the reference
 exercises stretched grids in tests/poisson and tests/geometry)."""
 import numpy as np
-import pytest
 
 from dccrg_tpu import Grid, StretchedCartesianGeometry, make_mesh
 from dccrg_tpu.models.poisson import Poisson
