@@ -28,7 +28,7 @@ from .geometry import CartesianGeometry, NoGeometry
 from .parallel.epoch import build_epoch
 from .parallel.halo import HaloExchange
 from .parallel.mesh import SHARD_AXIS, make_mesh, shard_spec
-from .parallel.partition import block_partition, morton_partition
+from .parallel.partition import block_partition, hilbert_partition, morton_partition
 
 __all__ = ["Grid", "CellSpec", "HAS_NO_NEIGHBOR", "HAS_LOCAL_NEIGHBOR_OF",
            "HAS_LOCAL_NEIGHBOR_TO", "HAS_REMOTE_NEIGHBOR_OF",
@@ -128,7 +128,9 @@ class Grid:
 
         n0 = int(np.prod(self._length))
         cells = np.arange(1, n0 + 1, dtype=np.uint64)
-        if self._lb_method in ("HSFC", "SFC", "MORTON"):
+        if self._lb_method in ("HSFC", "SFC", "HILBERT"):
+            owner = hilbert_partition(self.mapping, cells, self.n_devices)
+        elif self._lb_method == "MORTON":
             owner = morton_partition(self.mapping, cells, self.n_devices)
         else:
             owner = block_partition(cells, self.n_devices)

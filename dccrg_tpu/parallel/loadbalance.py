@@ -7,8 +7,9 @@ are implemented natively over the replicated leaf directory:
 
 * ``RCB``/``RIB`` — weighted recursive coordinate bisection over cell
   centers (Zoltan's geometric methods);
-* ``HSFC``/``SFC``/``MORTON`` — space-filling-curve striping with
-  weight-balanced cuts;
+* ``HSFC``/``SFC``/``HILBERT`` — Hilbert space-filling-curve striping with
+  weight-balanced cuts (the curve sfc++ gives the reference);
+* ``MORTON`` — Z-order striping (cheaper keys, less compact parts);
 * ``BLOCK`` — id-order striping (the initial assignment);
 * ``GRAPH``/``HYPERGRAPH`` — served by the SFC partition: on a
   neighborhood-bounded grid the SFC cut approximates the minimum edge cut
@@ -24,7 +25,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .partition import morton_partition, weighted_blocks
+from .partition import hilbert_partition, morton_partition, weighted_blocks
 
 __all__ = ["compute_partition", "rcb_partition"]
 
@@ -76,6 +77,8 @@ def compute_partition(
     if method in ("RCB", "RIB"):
         centers = grid.geometry.get_center(leaves.cells)
         return rcb_partition(centers, n_parts, weights)
-    if method in ("HSFC", "SFC", "MORTON", "GRAPH", "HYPERGRAPH"):
+    if method in ("HSFC", "SFC", "HILBERT"):
+        return hilbert_partition(grid.mapping, leaves.cells, n_parts, weights)
+    if method in ("MORTON", "GRAPH", "HYPERGRAPH"):
         return morton_partition(grid.mapping, leaves.cells, n_parts, weights)
     raise ValueError(f"unknown load balancing method {method!r}")
