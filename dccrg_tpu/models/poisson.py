@@ -10,7 +10,12 @@ Poisson solver (``tests/poisson/poisson_solve.hpp``):
 * a finer face neighbor's contribution is divided by 4 — its 4 sub-faces
   share one coarse face (``poisson_solve.hpp:332-336``);
 * the biconjugate-gradient iteration of Numerical Recipes 2.7.6 with both
-  ``A·p`` and ``Aᵀ·p`` applied matrix-free (``poisson_solve.hpp:251-520``).
+  ``A·p`` and ``Aᵀ·p`` applied matrix-free (``poisson_solve.hpp:251-520``);
+* the reference's three cell roles (``poisson_solve.hpp:146-150, 829-965``):
+  cells listed in ``solve_cells`` are solved; cells in ``skip_cells`` are
+  treated as missing neighbors (factor 0 toward them); remaining cells are
+  *boundary* cells whose rhs/solution feed the solver (Dirichlet data) but
+  are never updated — boundary-boundary neighbor pairs are dropped.
 
 TPU-native formulation: the per-entry forward and transpose multipliers are
 precomputed host-side into ``[D, R, K]`` tables, so each BiCG iteration is
@@ -34,15 +39,43 @@ class Poisson:
         "solution": ((), np.float64),
     }
 
-    def __init__(self, grid, hood_id=None, dtype=np.float64):
+    #: cell roles, same codes as the reference (poisson_solve.hpp:146-150)
+    SOLVE_CELL = 0
+    BOUNDARY_CELL = 1
+    SKIP_CELL = 2
+
+    def __init__(self, grid, hood_id=None, dtype=np.float64,
+                 solve_cells=None, skip_cells=None):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
         self.tables = StencilTables(grid, hood_id, with_geometry=True)
         self._exchange = grid.halo(hood_id)
+        self._full_solve = solve_cells is None
+        self._build_cell_types(solve_cells, skip_cells)
         self._build_factors()
         self._solve = self._build_solver()
+
+    def _build_cell_types(self, solve_cells, skip_cells):
+        """Per-leaf role array (reference cache_system_info,
+        ``poisson_solve.hpp:829-965``): everything not solved or skipped is
+        a boundary cell; solve membership wins over skip."""
+        leaves = self.grid.epoch.leaves
+        N = len(leaves)
+        if solve_cells is None:
+            types = np.full(N, self.SOLVE_CELL, dtype=np.int8)
+            if skip_cells is not None and len(skip_cells):
+                pos = leaves.position(np.asarray(skip_cells, dtype=np.uint64))
+                types[pos] = self.SKIP_CELL
+        else:
+            types = np.full(N, self.BOUNDARY_CELL, dtype=np.int8)
+            if skip_cells is not None and len(skip_cells):
+                pos = leaves.position(np.asarray(skip_cells, dtype=np.uint64))
+                types[pos] = self.SKIP_CELL
+            pos = leaves.position(np.asarray(solve_cells, dtype=np.uint64))
+            types[pos] = self.SOLVE_CELL
+        self._cell_type_leaf = types
 
     # ---------------------------------------------------------- factors
 
@@ -78,6 +111,18 @@ class Poisson:
                 (n_overlap == 2) & (off[:, d] == -nlen_i), -(d + 1), direction
             )
 
+        # pairs involving a skip cell act as missing neighbors, and
+        # boundary-boundary pairs are dropped (poisson_solve.hpp:896-965)
+        types = self._cell_type_leaf
+        active_pair = (
+            (types[src] != self.SKIP_CELL)
+            & (types[nbr] != self.SKIP_CELL)
+            & ~(
+                (types[src] == self.BOUNDARY_CELL)
+                & (types[nbr] == self.BOUNDARY_CELL)
+            )
+        )
+
         half = 0.5 * grid.geometry.get_length(leaves.cells)   # (N, 3)
         # per-leaf center offsets toward face neighbors; missing neighbors
         # default to own size but give factor 0 (poisson_solve.hpp:716-724)
@@ -86,10 +131,10 @@ class Poisson:
         has_pos = np.zeros((N, 3), dtype=bool)
         has_neg = np.zeros((N, 3), dtype=bool)
         for d in range(3):
-            m = direction == d + 1
+            m = (direction == d + 1) & active_pair
             pos_off[src[m], d] = half[src[m], d] + half[nbr[m], d]
             has_pos[src[m], d] = True
-            m = direction == -(d + 1)
+            m = (direction == -(d + 1)) & active_pair
             neg_off[src[m], d] = -(half[src[m], d] + half[nbr[m], d])
             has_neg[src[m], d] = True
 
@@ -112,7 +157,7 @@ class Poisson:
         e_fwd = np.where(finer, e_fwd / 4.0, e_fwd)
         coarser = nlen_i > slen_i         # cell finer than neighbor
         e_rev = np.where(coarser, e_rev / 4.0, e_rev)
-        nonface = direction == 0
+        nonface = (direction == 0) | ~active_pair
         e_fwd[nonface] = 0.0
         e_rev[nonface] = 0.0
 
@@ -128,12 +173,15 @@ class Poisson:
             mult_fwd[d, rows, cols] = e_fwd[sel]
             mult_rev[d, rows, cols] = e_rev[sel]
 
-        # diagonal for every row (ghosts included, for cleanliness)
+        # diagonal + cell role for every row (ghosts included)
         scaling_rows = np.zeros((D, R))
+        type_rows = np.full((D, R), self.SKIP_CELL, dtype=np.int8)
         for d in range(D):
             lp, gp = epoch.local_pos[d], epoch.ghost_pos[d]
             scaling_rows[d, : len(lp)] = scaling_leaf[lp]
             scaling_rows[d, len(lp) : len(lp) + len(gp)] = scaling_leaf[gp]
+            type_rows[d, : len(lp)] = types[lp]
+            type_rows[d, len(lp) : len(lp) + len(gp)] = types[gp]
 
         from ..parallel.mesh import shard_spec
 
@@ -144,6 +192,12 @@ class Poisson:
         self._mult_fwd = put(mult_fwd)
         self._mult_rev = put(mult_rev)
         self._volume = put(np.asarray(self.tables.length).prod(-1))
+        solve_rows = np.asarray(self.tables.local_mask) & (
+            type_rows == self.SOLVE_CELL
+        )
+        self._solve_mask = jax.device_put(
+            jnp.asarray(solve_rows), shard_spec(self.grid.mesh, 2)
+        )
 
     # ----------------------------------------------------------- solver
 
@@ -156,18 +210,21 @@ class Poisson:
 
     def _build_solver(self):
         local = self.tables.local_mask
+        solve_mask = self._solve_mask
         mult_fwd, mult_rev = self._mult_fwd, self._mult_rev
 
         def dot(a, b):
-            return jnp.sum(jnp.where(local, a * b, 0.0))
+            return jnp.sum(jnp.where(solve_mask, a * b, 0.0))
 
         @jax.jit
         def solve(state, max_iterations, stop_residual, stop_after_increase):
-            rhs = jnp.where(local, state["rhs"], 0.0)
+            rhs = jnp.where(solve_mask, state["rhs"], 0.0)
+            # boundary cells keep their given solution values: they feed
+            # the initial residual (Dirichlet lifting) but never change
             x = jnp.where(local, state["solution"], 0.0)
 
             Ax, _ = self._apply(x, mult_fwd)
-            r0 = jnp.where(local, rhs - Ax, 0.0)
+            r0 = jnp.where(solve_mask, rhs - Ax, 0.0)
             r1 = r0
             p0, p1 = r0, r1
             dot_r = dot(r0, r1)
@@ -188,8 +245,14 @@ class Poisson:
 
             def body(carry):
                 i, x, r0, r1, p0, p1, dot_r, _, best_res, best_x = carry
+                # restrict the operator to solve rows: boundary/skip rows
+                # are local and never ghost-refreshed, so unmasked values
+                # would leak into r and p (reference updates SOLVE cells
+                # only, poisson_solve.hpp:405-520)
                 Ap0, _ = self._apply(p0, mult_fwd)
+                Ap0 = jnp.where(solve_mask, Ap0, 0.0)
                 ATp1, _ = self._apply(p1, mult_rev)
+                ATp1 = jnp.where(solve_mask, ATp1, 0.0)
                 dot_p = dot(p1, Ap0)
                 alpha = jnp.where(dot_p != 0, dot_r / dot_p, 0.0)
                 x = x + alpha * p0
@@ -223,7 +286,7 @@ class Poisson:
         # zero-mean the charge like the reference tests do for all-periodic
         # grids (volume-weighted so AMR stays consistent)
         vol = np.prod(grid.geometry.get_length(cells), axis=-1)
-        if all(grid.topology.periodic):
+        if all(grid.topology.periodic) and self._full_solve:
             rhs = rhs - (rhs * vol).sum() / vol.sum()
         return grid.set_cell_data(state, "rhs", cells, rhs)
 
@@ -244,7 +307,6 @@ class Poisson:
         return state, float(res), int(it)
 
     def residual(self, state) -> float:
-        local = self.tables.local_mask
         Ax, _ = self._apply(state["solution"], self._mult_fwd)
-        r = np.asarray(jnp.where(local, state["rhs"] - Ax, 0.0))
+        r = np.asarray(jnp.where(self._solve_mask, state["rhs"] - Ax, 0.0))
         return float(np.sqrt((r * r).sum()))

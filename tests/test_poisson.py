@@ -168,3 +168,116 @@ def test_device_count_invariance():
         sol = g.get_cell_data(state, "solution", g.get_cells())
         sols.append(sol - sol.mean())
     np.testing.assert_allclose(sols[0], sols[1], atol=1e-10)
+
+
+def test_skip_cells_embedded_1d():
+    """Reference poisson1d_skip_cells.cpp: a 1-D problem embedded in a
+    wider grid, with every off-line cell skipped, must solve identically
+    to the genuinely 1-D grid (skipped neighbors act as missing)."""
+    g1 = make_grid((8, 1, 1), periodic=(True, False, False))
+    x1 = g1.geometry.get_center(g1.get_cells())[:, 0]
+    rhs_of = lambda x: np.sin(2 * np.pi * x)
+    p1 = Poisson(g1)
+    s1 = p1.initialize_state(rhs_of(x1) - rhs_of(x1).mean())
+    s1, res1, _ = p1.solve(s1, max_iterations=500, stop_residual=1e-13)
+    sol1 = g1.get_cell_data(s1, "solution", g1.get_cells())
+
+    g3 = make_grid((8, 3, 1), periodic=(True, False, False),
+                   cell_len=(1 / 8, 1.0, 1.0))
+    cells = g3.get_cells()
+    cy = g3.geometry.get_center(cells)[:, 1]
+    line = cells[np.isclose(cy, 1.5)]
+    skip = cells[~np.isclose(cy, 1.5)]
+    p3 = Poisson(g3, solve_cells=line, skip_cells=skip)
+    x3 = g3.geometry.get_center(cells)[:, 0]
+    s3 = p3.initialize_state(rhs_of(x3) - rhs_of(x3).mean())
+    s3, res3, _ = p3.solve(s3, max_iterations=500, stop_residual=1e-13)
+    sol3 = g3.get_cell_data(s3, "solution", line)
+    order = np.argsort(g3.geometry.get_center(line)[:, 0])
+    np.testing.assert_allclose(
+        sol3[order] - sol3.mean(), sol1 - sol1.mean(), atol=1e-9
+    )
+    # skipped cells are never written
+    np.testing.assert_array_equal(g3.get_cell_data(s3, "solution", skip), 0.0)
+
+
+def test_boundary_cells_dirichlet_1d():
+    """Reference poisson1d_boundary.cpp: end cells act as fixed Dirichlet
+    data — used by the solver, never updated."""
+    n = 32
+    L = 2 * np.pi
+    g = make_grid((n, 1, 1), periodic=(False, False, False),
+                  cell_len=(L / n, 1.0, 1.0))
+    cells = g.get_cells()
+    x = g.geometry.get_center(cells)[:, 0]
+    interior = cells[1:-1]
+    bnd = cells[[0, -1]]
+    exact = -np.sin(x)
+    p = Poisson(g, solve_cells=interior)
+    state = p.initialize_state(np.sin(x))
+    state = g.set_cell_data(state, "solution", bnd, exact[[0, -1]])
+    state, res, _ = p.solve(state, max_iterations=2000, stop_residual=1e-13)
+    sol = g.get_cell_data(state, "solution", cells)
+    np.testing.assert_array_equal(sol[[0, -1]], exact[[0, -1]])
+    np.testing.assert_allclose(sol[1:-1], exact[1:-1], atol=5e-3)
+
+
+def test_boundary_and_skip_match_dense_oracle():
+    """Role-aware dense oracle: the solved block must equal the direct
+    solution of A_ss x = rhs_s - A_sb u_b with skip neighbors removed."""
+    g = make_grid((6, 4, 1), periodic=(False, False, False),
+                  cell_len=(1 / 6, 1 / 4, 1.0))
+    cells = g.get_cells()
+    centers = g.geometry.get_center(cells)
+    skip = cells[(centers[:, 0] > 5 / 6) & (centers[:, 1] > 3 / 4)]
+    bnd = cells[centers[:, 0] < 1 / 6]
+    sset, bset = set(skip.tolist()), set(bnd.tolist())
+    solve = np.array([c for c in cells if int(c) not in sset and int(c) not in bset],
+                     dtype=np.uint64)
+    p = Poisson(g, solve_cells=solve, skip_cells=skip)
+
+    rng = np.random.default_rng(4)
+    rhs = rng.standard_normal(len(cells))
+    ub = rng.standard_normal(len(bnd))
+    state = p.initialize_state(rhs)
+    state = g.set_cell_data(state, "solution", bnd, ub)
+    state, res, _ = p.solve(state, max_iterations=1000, stop_residual=1e-13)
+    sol = g.get_cell_data(state, "solution", cells)
+
+    # oracle with the same role rules
+    pos = {int(c): i for i, c in enumerate(cells)}
+    n = len(cells)
+    A = np.zeros((n, n))
+    lengths = g.geometry.get_length(cells)
+    for i, c in enumerate(cells):
+        if int(c) in sset:
+            continue
+        half = lengths[i] / 2
+        offs = {+1: 2 * half[0], -1: -2 * half[0], +2: 2 * half[1],
+                -2: -2 * half[1], +3: 2 * half[2], -3: -2 * half[2]}
+        present = set()
+        fn = [(nid, d) for nid, d in g.get_face_neighbors_of(int(c))
+              if int(nid) not in sset
+              and not (int(c) in bset and int(nid) in bset)]
+        for nid, d in fn:
+            j = pos[int(nid)]
+            nh = lengths[j] / 2
+            ax = abs(d) - 1
+            off = half[ax] + nh[ax]
+            offs[d] = off if d > 0 else -off
+            present.add(d)
+        total = {1: offs[1] - offs[-1], 2: offs[2] - offs[-2], 3: offs[3] - offs[-3]}
+        f = {}
+        for d in (+1, +2, +3):
+            f[d] = 2.0 / (offs[d] * total[d]) if d in present else 0.0
+        for d in (-1, -2, -3):
+            f[d] = -2.0 / (offs[d] * total[-d]) if d in present else 0.0
+        A[i, i] = -sum(f.values())
+        for nid, d in fn:
+            A[i, pos[int(nid)]] += f[d]
+
+    si = np.array([pos[int(c)] for c in solve])
+    bi = np.array([pos[int(c)] for c in bnd])
+    b_eff = rhs[si] - A[np.ix_(si, bi)] @ ub
+    want = np.linalg.solve(A[np.ix_(si, si)], b_eff)
+    np.testing.assert_allclose(sol[si], want, atol=1e-8)
