@@ -28,6 +28,7 @@ import numpy as np
 
 from ..core.mapping import ERROR_CELL, Mapping
 from ..core.neighbors import LeafSet, find_all_neighbors
+from ..utils.setops import csr_take, unique_u64
 
 __all__ = ["AmrQueues", "commit_adaptation"]
 
@@ -49,15 +50,18 @@ class AmrQueues:
 def _symmetric_adjacency(n_cells: int, hood) -> tuple[np.ndarray, np.ndarray]:
     """CSR adjacency of neighbors_of ∪ neighbors_to (both directions) over
     leaf positions — the edge set both fixed points walk."""
+    from ..utils.setops import counts_to_start, unique_pairs
+
     counts = np.diff(hood.lists.start)
     src = np.repeat(np.arange(n_cells, dtype=np.int64), counts)
-    fwd = np.stack([src, hood.lists.nbr_pos], axis=1)
-    rev = fwd[:, ::-1]
-    edges = np.unique(np.concatenate([fwd, rev], axis=0), axis=0)
-    start = np.zeros(n_cells + 1, dtype=np.int64)
-    np.add.at(start[1:], edges[:, 0], 1)
-    np.cumsum(start, out=start)
-    return start, edges[:, 1]
+    nbr = hood.lists.nbr_pos
+    a, b = unique_pairs(
+        np.concatenate([src, nbr]),
+        np.concatenate([nbr, src]),
+        max(n_cells, 1),
+    )
+    start = counts_to_start(a, n_cells)
+    return start, b
 
 
 def override_refines(
@@ -74,9 +78,9 @@ def override_refines(
         # all neighbors of the frontier with larger refinement level
         counts = start[frontier + 1] - start[frontier]
         srcs = np.repeat(frontier, counts)
-        nbrs = np.concatenate([nbr[start[f] : start[f + 1]] for f in frontier]) if len(frontier) else np.zeros(0, np.int64)
+        nbrs = csr_take(start, nbr, frontier)
         finer = nbrs[(lvl[nbrs] > lvl[srcs]) & ~dont[nbrs]]
-        frontier = np.unique(finer)
+        frontier = unique_u64(finer.astype(np.uint64)).astype(np.int64)
         dont[frontier] = True
 
     vetoed = set(leaves.cells[dont].tolist())
@@ -96,9 +100,9 @@ def induce_refines(leaves: LeafSet, lvl: np.ndarray, adj: tuple, queues: AmrQueu
     while len(frontier):
         counts = start[frontier + 1] - start[frontier]
         srcs = np.repeat(frontier, counts)
-        nbrs = np.concatenate([nbr[start[f] : start[f + 1]] for f in frontier]) if len(frontier) else np.zeros(0, np.int64)
+        nbrs = csr_take(start, nbr, frontier)
         coarser = nbrs[(lvl[nbrs] < lvl[srcs]) & ~refine[nbrs]]
-        frontier = np.unique(coarser)
+        frontier = unique_u64(coarser.astype(np.uint64)).astype(np.int64)
         refine[frontier] = True
     queues.to_refine = set(leaves.cells[refine].tolist())
 

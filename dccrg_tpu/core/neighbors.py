@@ -235,12 +235,10 @@ def invert_neighbors(n_cells: int, lists: NeighborLists) -> tuple[np.ndarray, np
     ``src_pos[start[j]:start[j+1]]`` are positions of cells having leaf j as
     a neighbor, sorted ascending.
     """
-    src = np.repeat(
-        np.arange(len(lists.start) - 1, dtype=np.int64),
-        np.diff(lists.start),
-    )
-    pairs = np.unique(np.stack([lists.nbr_pos, src], axis=1), axis=0)
-    start = np.zeros(n_cells + 1, dtype=np.int64)
-    np.add.at(start[1:], pairs[:, 0], 1)
-    np.cumsum(start, out=start)
-    return start, pairs[:, 1]
+    from ..utils.setops import counts_to_start, unique_pairs
+
+    n_src = len(lists.start) - 1
+    src = np.repeat(np.arange(n_src, dtype=np.int64), np.diff(lists.start))
+    nbr_u, src_u = unique_pairs(lists.nbr_pos, src, max(n_src, 1))
+    start = counts_to_start(nbr_u, n_cells)
+    return start, src_u

@@ -162,7 +162,9 @@ class Poisson:
         e_rev[nonface] = 0.0
 
         # scatter into [D, R, K] aligned with the epoch's gather tables
-        ecol = np.concatenate([np.arange(c) for c in counts]) if N else np.zeros(0, int)
+        ecol = np.arange(int(lists.start[-1]), dtype=np.int64) - np.repeat(
+            lists.start[:-1], counts
+        )
         owner = leaves.owner.astype(np.int64)
         mult_fwd = np.zeros((D, R, K))
         mult_rev = np.zeros((D, R, K))

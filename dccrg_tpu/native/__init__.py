@@ -13,7 +13,7 @@ import subprocess
 
 import numpy as np
 
-__all__ = ["native_find_neighbors", "native_available"]
+__all__ = ["native_find_neighbors", "native_sort_unique_u64", "native_available"]
 
 _DIR = pathlib.Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libneighbor_kernels.so"
@@ -59,12 +59,25 @@ def _load():
         u64p, i64p, i64p, i32p,          # out_nbr, out_pos, out_offset, out_slot
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.sort_unique_u64.restype = ctypes.c_int64
+    lib.sort_unique_u64.argtypes = [u64p, ctypes.c_int64]
     _lib = lib
     return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_sort_unique_u64(keys: np.ndarray):
+    """Parallel in-place sort + dedupe; returns the sorted unique prefix
+    (a view of ``keys``) or None if the native library is unavailable.
+    ``keys`` must be contiguous uint64 and is clobbered."""
+    lib = _load()
+    if lib is None:
+        return None
+    m = lib.sort_unique_u64(keys, len(keys))
+    return keys[:m]
 
 
 def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, strict):

@@ -12,11 +12,13 @@
 // Build: g++ -O3 -march=native -fopenmp -shared -fPIC
 //        -o libneighbor_kernels.so neighbor_kernels.cpp
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 
 #ifdef _OPENMP
 #include <omp.h>
+#include <parallel/algorithm>
 #endif
 
 namespace {
@@ -217,6 +219,19 @@ int find_neighbors(
         counts[i] = n_entries;
     }
     return error;
+}
+
+// In-place parallel sort + dedupe of uint64 keys; returns the unique
+// count.  Backs the packed-pair set operations (utils/setops.py) that
+// dominate epoch rebuilds after AMR/load balancing — np.unique's serial
+// sort is the equivalent fallback.
+int64_t sort_unique_u64(uint64_t* keys, int64_t n) {
+#ifdef _OPENMP
+    __gnu_parallel::sort(keys, keys + n);
+#else
+    std::sort(keys, keys + n);
+#endif
+    return std::unique(keys, keys + n) - keys;
 }
 
 }  // extern "C"

@@ -108,15 +108,20 @@ def _build_hood(
     owner = leaves.owner.astype(np.int64)
 
     # --- ghost requirement: remote cells in neighbors_of/to of local cells
+    from ..utils.setops import unique_pairs
+
     src_of = np.repeat(np.arange(N), np.diff(lists.start))
     # (device needing, remote pos) from neighbors_of
     mask = owner[src_of] != owner[lists.nbr_pos]
-    of_pairs = np.stack([owner[src_of][mask], lists.nbr_pos[mask]], axis=1)
     # from neighbors_to
     src_to = np.repeat(np.arange(N), np.diff(to_start))
     mask_t = owner[src_to] != owner[to_src]
-    to_pairs = np.stack([owner[src_to][mask_t], to_src[mask_t]], axis=1)
-    pairs = np.unique(np.concatenate([of_pairs, to_pairs], axis=0), axis=0)
+    dev_u, pos_u = unique_pairs(
+        np.concatenate([owner[src_of][mask], owner[src_to][mask_t]]),
+        np.concatenate([lists.nbr_pos[mask], to_src[mask_t]]),
+        max(N, 1),
+    )
+    pairs = np.stack([dev_u, pos_u], axis=1)
     return lists, to_start, to_src, pairs
 
 
@@ -143,11 +148,14 @@ def build_epoch(
         lists, to_start, to_src, pairs = _build_hood(mapping, topology, leaves, offsets)
         hood_raw[hid] = (offsets, lists, to_start, to_src, pairs)
         all_pairs.append(pairs)
-    pairs = (
-        np.unique(np.concatenate(all_pairs, axis=0), axis=0)
-        if all_pairs
-        else np.zeros((0, 2), dtype=np.int64)
-    )
+    if all_pairs:
+        from ..utils.setops import unique_pairs
+
+        cat = np.concatenate(all_pairs, axis=0)
+        dev_u, pos_u = unique_pairs(cat[:, 0], cat[:, 1], max(N, 1))
+        pairs = np.stack([dev_u, pos_u], axis=1)
+    else:
+        pairs = np.zeros((0, 2), dtype=np.int64)
 
     # --- row layout
     local_pos = [np.flatnonzero(owner == d) for d in range(D)]
@@ -217,26 +225,37 @@ def _finish_hood(
     # --- halo schedule: for each (receiver j, sender i) the cells are the
     # hood's ghost pairs; order by cell id (= by position) like the
     # reference's sorted send/recv lists (dccrg.hpp:8590-8752)
+    recv_d = pairs[:, 0]
+    gpos = pairs[:, 1]
+    send_d = owner[gpos]
     pair_counts = np.zeros((D, D), dtype=np.int64)
-    cell_sets = {}
-    for j in range(D):
-        p = pairs[pairs[:, 0] == j, 1]
-        if len(p) == 0:
-            continue
-        senders = owner[p]
-        for i in np.unique(senders):
-            cp = np.sort(p[senders == i])
-            cell_sets[(i, j)] = cp
-            pair_counts[i, j] = len(cp)
+    if len(pairs):
+        np.add.at(pair_counts, (send_d, recv_d), 1)
     S = int(pair_counts.max()) if pair_counts.size else 0
     S = max(S, 1)
     send_rows = np.full((D, D, S), scratch, dtype=np.int32)
     recv_rows = np.full((D, D, S), scratch, dtype=np.int32)
-    for (i, j), cp in cell_sets.items():
-        send_rows[i, j, : len(cp)] = epoch.row_of[cp]
-        recv_rows[j, i, : len(cp)] = epoch.rows_on_device(j, cp)
+    if len(pairs):
+        # group by (sender, receiver), position-sorted within each group
+        gkey = (send_d * D + recv_d) * np.int64(max(N, 1)) + gpos
+        order = np.argsort(gkey, kind="stable")
+        sd, rd, gp = send_d[order], recv_d[order], gpos[order]
+        grp_start = np.flatnonzero(
+            np.concatenate(([True], (sd[1:] != sd[:-1]) | (rd[1:] != rd[:-1])))
+        )
+        in_grp = np.arange(len(gp)) - np.repeat(grp_start, np.diff(
+            np.concatenate((grp_start, [len(gp)]))
+        ))
+        send_rows[sd, rd, in_grp] = epoch.row_of[gp]
+        # receive rows: per receiving device, ghost index lookup
+        rrow = np.empty(len(gp), dtype=np.int64)
+        for d in range(D):
+            m = rd == d
+            if m.any():
+                rrow[m] = epoch.rows_on_device(d, gp[m])
+        recv_rows[rd, sd, in_grp] = rrow
 
-    # --- neighbor gather tables over local rows
+    # --- neighbor gather tables over local rows (flat one-pass scatters)
     counts = np.diff(lists.start)
     Kmax = int(counts.max()) if N else 1
     Kmax = max(Kmax, 1)
@@ -245,20 +264,26 @@ def _finish_hood(
     nbr_offset = np.zeros((D, R, Kmax, 3), dtype=np.int32)
     nbr_len = np.zeros((D, R, Kmax), dtype=np.int32)
     nbr_slot = np.zeros((D, R, Kmax), dtype=np.int32)
-    ecol = np.concatenate([np.arange(c) for c in counts]) if N else np.zeros(0, int)
-    esrc = np.repeat(np.arange(N), counts)
-    for d in range(D):
-        sel = owner[esrc] == d
-        if not sel.any():
-            continue
-        rows = epoch.row_of[esrc[sel]]
-        cols = ecol[sel]
-        nrows = epoch.rows_on_device(d, lists.nbr_pos[sel])
-        nbr_rows[d, rows, cols] = nrows
-        nbr_valid[d, rows, cols] = True
-        nbr_offset[d, rows, cols] = lists.offset[sel]
-        nbr_len[d, rows, cols] = len_all[lists.nbr_pos[sel]]
-        nbr_slot[d, rows, cols] = lists.slot[sel]
+    E = int(lists.start[-1])
+    if E:
+        esrc = np.repeat(np.arange(N), counts)
+        ecol = np.arange(E, dtype=np.int64) - np.repeat(lists.start[:-1], counts)
+        edev = owner[esrc]
+        flat = (edev * R + epoch.row_of[esrc]) * Kmax + ecol
+        # row of each neighbor on the source's device
+        nrows = np.empty(E, dtype=np.int64)
+        local_e = owner[lists.nbr_pos] == edev
+        nrows[local_e] = epoch.row_of[lists.nbr_pos[local_e]]
+        rem = np.flatnonzero(~local_e)
+        for d in range(D):
+            sub = rem[edev[rem] == d]
+            if len(sub):
+                nrows[sub] = epoch.rows_on_device(d, lists.nbr_pos[sub])
+        nbr_rows.reshape(-1)[flat] = nrows
+        nbr_valid.reshape(-1)[flat] = True
+        nbr_offset.reshape(-1, 3)[flat] = lists.offset
+        nbr_len.reshape(-1)[flat] = len_all[lists.nbr_pos]
+        nbr_slot.reshape(-1)[flat] = lists.slot
 
     # --- inner/outer split (dccrg.hpp:7478-7519): outer = local cell with a
     # remote cell among neighbors_of or neighbors_to
@@ -267,8 +292,8 @@ def _finish_hood(
     src_to = np.repeat(np.arange(N), np.diff(to_start))
     remote_to = owner[src_to] != owner[to_src]
     is_outer = np.zeros(N, dtype=bool)
-    np.logical_or.at(is_outer, src_of[remote_of], True)
-    np.logical_or.at(is_outer, src_to[remote_to], True)
+    is_outer[src_of[remote_of]] = True
+    is_outer[src_to[remote_to]] = True
     inner_mask = np.zeros((D, R), dtype=bool)
     outer_mask = np.zeros((D, R), dtype=bool)
     for d in range(D):

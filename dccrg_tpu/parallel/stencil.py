@@ -94,11 +94,8 @@ class StencilTables:
             lists = hood.lists
             counts = np.diff(lists.start)
             src = np.repeat(np.arange(len(leaves)), counts)
-            ecol = (
-                np.concatenate([np.arange(c) for c in counts])
-                if len(leaves)
-                else np.zeros(0, int)
-            )
+            E = int(lists.start[-1])
+            ecol = np.arange(E, dtype=np.int64) - np.repeat(lists.start[:-1], counts)
             owner = leaves.owner.astype(np.int64)
             D, R, K = hood.nbr_rows.shape
             for name, fn in neighbor_items.items():

@@ -55,3 +55,33 @@ def test_native_strict_error():
     )
     with pytest.raises(RuntimeError, match="no neighbor leaf|not an existing leaf"):
         find_all_neighbors(m, t, leaves, default_neighborhood(0))
+
+
+def test_native_sort_unique_matches_numpy():
+    from dccrg_tpu.native import native_available, native_sort_unique_u64
+
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 1 << 48, size=100_000, dtype=np.uint64)
+    keys = np.concatenate([keys, keys[:5000]])  # force duplicates
+    want = np.unique(keys)
+    if native_available():
+        got = native_sort_unique_u64(keys.copy())
+        np.testing.assert_array_equal(got, want)
+
+
+def test_setops_helpers():
+    from dccrg_tpu.utils.setops import counts_to_start, csr_take, unique_pairs
+
+    a = np.array([3, 1, 3, 1, 0, 3])
+    b = np.array([2, 0, 2, 5, 1, 0])
+    ua, ub = unique_pairs(a, b, 8)
+    want = np.unique(np.stack([a, b], axis=1), axis=0)
+    np.testing.assert_array_equal(np.stack([ua, ub], axis=1), want)
+
+    start = counts_to_start(np.array([0, 0, 2, 2, 2]), 4)
+    np.testing.assert_array_equal(start, [0, 2, 2, 5, 5])
+
+    data = np.arange(10) * 10
+    start = np.array([0, 3, 3, 7, 10])
+    got = csr_take(start, data, np.array([2, 0, 3]))
+    np.testing.assert_array_equal(got, [30, 40, 50, 60, 0, 10, 20, 70, 80, 90])
