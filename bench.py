@@ -56,10 +56,15 @@ def measure_tpu() -> dict:
     # warmup + compile (device-side loop: one dispatch for the whole run)
     jax.block_until_ready(adv.run(state, 2, dt))
 
-    t0 = time.perf_counter()
-    state = adv.run(state, STEPS, dt)
-    jax.block_until_ready(state)
-    secs = time.perf_counter() - t0
+    # best of 3: the device is reached through a shared tunnel whose
+    # slowdowns are one-sided noise, so min time estimates capability
+    secs = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = adv.run(state, STEPS, dt)
+        jax.block_until_ready(out)
+        secs = min(secs, time.perf_counter() - t0)
+    state = out
 
     n_cells = NX * NY * NZ
     updates_per_s = n_cells * STEPS / secs

@@ -27,7 +27,19 @@ try:
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
-__all__ = ["pallas_available", "make_flux_update"]
+__all__ = [
+    "pallas_available",
+    "make_flux_update",
+    "make_fused_run",
+    "fused_run_fits",
+]
+
+# VMEM footprint cap for the whole-block fused-run kernel (v5e has ~128 MB
+# of VMEM; the kernel's resident set is ~17 block-sized arrays — in, out,
+# scratch, 3 velocities, 4 face velocities + 4 weights + select masks,
+# ~3 live temporaries)
+_FUSED_VMEM_BUDGET = 72 * 1024 * 1024
+_FUSED_ARRAYS = 17
 
 
 def pallas_available(dtype) -> bool:
@@ -41,18 +53,20 @@ def pallas_available(dtype) -> bool:
         return False
 
 
-def _roll_m1(x, axis):
-    """x shifted so element i sees element i+1 (wrapping); pltpu.roll only
-    takes non-negative shifts, so -1 is size-1."""
-    return pltpu.roll(x, x.shape[axis] - 1, axis)
+def _make_rolls(interpret: bool):
+    """(roll_m1, roll_p1): element i sees i+1 / i-1 (wrapping).  pltpu.roll
+    only takes non-negative shifts (-1 is size-1); interpret mode uses
+    jnp.roll, which has identical semantics."""
+    if interpret:
+        return (lambda x, a: jnp.roll(x, -1, a)), (lambda x, a: jnp.roll(x, 1, a))
+    return (
+        lambda x, a: pltpu.roll(x, x.shape[a] - 1, a),
+        lambda x, a: pltpu.roll(x, 1, a),
+    )
 
 
-def _roll_p1(x, axis):
-    """x shifted so element i sees element i-1 (wrapping)."""
-    return pltpu.roll(x, 1, axis)
-
-
-def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float):
+def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float,
+                     *, interpret: bool = False):
     """Returns ``update(rho_ext, vx, vy, vz_ext, mx, my, mz_up, mz_dn, dt)
     -> new_rho`` over one device's block, as a fused Pallas call tiled over
     z-slabs.  The z-neighbor planes are read straight out of the
@@ -60,6 +74,7 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float):
     are materialized in HBM."""
     area_x, area_y, area_z = (float(a) for a in area)
     inv_vol = float(inv_vol)
+    _roll_m1, _roll_p1 = _make_rolls(interpret)
 
     def kernel(dt_ref, r_lo, r_c, r_hi, vx, vy, vz_lo, vz_c, vz_hi,
                mx, my, mzu, mzd, out):
@@ -68,19 +83,19 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float):
 
         rxp = _roll_m1(r, 2)
         vfx = (vx[...] + _roll_m1(vx[...], 2)) * 0.5
-        fx = jnp.where(vfx >= 0, r, rxp) * dt * vfx * area_x
+        fx = jnp.where(vfx >= 0, r, rxp) * (dt * vfx * area_x)
         fx = fx * mx[...]
 
         ryp = _roll_m1(r, 1)
         vfy = (vy[...] + _roll_m1(vy[...], 1)) * 0.5
-        fy = jnp.where(vfy >= 0, r, ryp) * dt * vfy * area_y
+        fy = jnp.where(vfy >= 0, r, ryp) * (dt * vfy * area_y)
         fy = fy * my[...]
 
         vfz_hi = (vz_c[...] + vz_hi[...]) * 0.5
-        fz = jnp.where(vfz_hi >= 0, r, r_hi[...]) * dt * vfz_hi * area_z
+        fz = jnp.where(vfz_hi >= 0, r, r_hi[...]) * (dt * vfz_hi * area_z)
         fz = fz * mzu[...]
         vfz_lo = (vz_lo[...] + vz_c[...]) * 0.5
-        fzd = jnp.where(vfz_lo >= 0, r_lo[...], r) * dt * vfz_lo * area_z
+        fzd = jnp.where(vfz_lo >= 0, r_lo[...], r) * (dt * vfz_lo * area_z)
         fzd = fzd * mzd[...]
 
         # accumulate in the XLA body's slot order: z-, y-, x-, x+, y+, z+
@@ -118,6 +133,7 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float):
             out_specs=vspec,
         ),
         out_shape=jax.ShapeDtypeStruct((nzl, ny, nx), jnp.float32),
+        interpret=interpret,
     )
 
     def update(rho_ext, vx, vy, vz_ext, mx, my, mz_up, mz_dn, dt):
@@ -128,3 +144,114 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float):
         )
 
     return update
+
+
+def fused_run_fits(nzl: int, ny: int, nx: int) -> bool:
+    """Whether the whole-block multi-step kernel's VMEM resident set fits."""
+    return _FUSED_ARRAYS * nzl * ny * nx * 4 <= _FUSED_VMEM_BUDGET
+
+
+def make_fused_run(nzl: int, ny: int, nx: int, area, inv_vol: float,
+                   *, interpret: bool = False):
+    """Returns ``run(rho, vx, vy, vz, mx, my, mz_up, mz_dn, dt, steps) ->
+    new_rho`` advancing ``steps`` timesteps in ONE kernel launch with every
+    array resident in VMEM (temporal blocking taken to its limit: zero HBM
+    traffic inside the step loop, so the stencil runs compute-bound instead
+    of bandwidth-bound).
+
+    Single-device blocks only: z-neighbors are whole-array rolls, which is
+    exactly the one-device degenerate ring of parallel/dense.py::HaloExtend
+    (wrapping planes; non-periodic z is handled by the same face masks).
+    Per-step arithmetic mirrors make_flux_update with the loop-invariant
+    parts (face velocities, upwind masks, dt*v_face*area*mask weights)
+    hoisted out of the step loop; the hoists are value-preserving (masks
+    are exactly 0/1), so the result matches applying the one-step kernel
+    ``steps`` times bit for bit (up to the sign of zero on masked faces).
+    ``steps`` is a runtime scalar — no retrace per step count."""
+    area_x, area_y, area_z = (float(a) for a in area)
+    inv_vol = float(inv_vol)
+    roll_m1, roll_p1 = _make_rolls(interpret)
+
+    def kernel(dt_ref, steps_ref, rho_ref, vx_ref, vy_ref, vz_ref,
+               mx_ref, my_ref, mzu_ref, mzd_ref, out_ref, scr_ref):
+        dt = dt_ref[0]
+        steps = steps_ref[0]
+        mx, my = mx_ref[...], my_ref[...]
+        mzu, mzd = mzu_ref[...], mzd_ref[...]
+        vx, vy, vz = vx_ref[...], vy_ref[...], vz_ref[...]
+        # loop-invariant hoists: face velocities, their upwind-side masks,
+        # and the full face weight dt*v_face*area*mask — per step only the
+        # upwind select and one multiply remain per direction (values match
+        # the one-step kernel: masks are exactly 0/1, so folding them into
+        # the weight is exact)
+        vfx = (vx + roll_m1(vx, 2)) * 0.5
+        vfy = (vy + roll_m1(vy, 1)) * 0.5
+        vfz_hi = (vz + roll_m1(vz, 0)) * 0.5
+        vfz_lo = (roll_p1(vz, 0) + vz) * 0.5
+        sel_x, sel_y = vfx >= 0, vfy >= 0
+        sel_zhi, sel_zlo = vfz_hi >= 0, vfz_lo >= 0
+        wx = (dt * vfx * area_x) * mx
+        wy = (dt * vfy * area_y) * my
+        wzu = (dt * vfz_hi * area_z) * mzu
+        wzd = (dt * vfz_lo * area_z) * mzd
+
+        def one_step(src_ref, dst_ref):
+            r = src_ref[...]
+            fx = jnp.where(sel_x, r, roll_m1(r, 2)) * wx
+            fy = jnp.where(sel_y, r, roll_m1(r, 1)) * wy
+            fz = jnp.where(sel_zhi, r, roll_m1(r, 0)) * wzu
+            fzd = jnp.where(sel_zlo, roll_p1(r, 0), r) * wzd
+            flux = fzd
+            flux = flux + roll_p1(fy, 1)
+            flux = flux + roll_p1(fx, 2)
+            flux = flux - fx
+            flux = flux - fy
+            flux = flux - fz
+            dst_ref[...] = r + flux * inv_vol
+
+        out_ref[...] = rho_ref[...]
+
+        def body(i, _):
+            even = (i % 2) == 0
+
+            @pl.when(even)
+            def _():
+                one_step(out_ref, scr_ref)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                one_step(scr_ref, out_ref)
+
+            return 0
+
+        jax.lax.fori_loop(0, steps, body, 0)
+
+        @pl.when((steps % 2) == 1)
+        def _():
+            out_ref[...] = scr_ref[...]
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    kwargs = {}
+    if not interpret:
+        # the resident set intentionally exceeds the default 16 MB scoped
+        # limit — v5e+ has ~128 MB of VMEM and fused_run_fits() gates entry
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_FUSED_VMEM_BUDGET + 24 * 1024 * 1024
+        )
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[smem, smem] + [vmem] * 8,
+        out_specs=vmem,
+        scratch_shapes=[pltpu.VMEM((nzl, ny, nx), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((nzl, ny, nx), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+    def run(rho, vx, vy, vz, mx, my, mz_up, mz_dn, dt, steps):
+        dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
+        steps_arr = jnp.asarray(steps, jnp.int32).reshape(1)
+        return call(dt_arr, steps_arr, rho, vx, vy, vz, mx, my, mz_up, mz_dn)
+
+    return run
