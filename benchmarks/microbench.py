@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Microbenchmarks mirroring the reference's runtime-printed speed tests:
+
+- geometry query speed (coord->cell, cell->center) — the analogue of
+  tests/geometry/cartesian_grid_speed.cpp and
+  stretched_cartesian_grid_speed.cpp
+- refinement throughput (cells refined/s through the full commit
+  pipeline) — the analogue of tests/refine/scalability.cpp
+
+Prints one JSON line per metric.  Host-side work: runs the same anywhere
+(the cell-id algebra and AMR commit are host components by design).
+
+Usage: python benchmarks/microbench.py [--n 1000000] [--refine-length 32]
+"""
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+
+def bench_geometry(n: int):
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.geometry.stretched import StretchedCartesianGeometry
+
+    g = (
+        Grid()
+        .set_initial_length((64, 64, 64))
+        .set_maximum_refinement_level(3)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0, 1.0, 1.0),
+        )
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    rng = np.random.default_rng(0)
+    coords = rng.uniform(0.0, 64.0, size=(n, 3))
+    cells = g.get_cells()
+    ids = rng.choice(cells, size=n)
+
+    t0 = time.perf_counter()
+    found = g.geometry.get_cell(0, coords)
+    t_coord = time.perf_counter() - t0
+    assert (found > 0).all()
+
+    t0 = time.perf_counter()
+    centers = g.geometry.get_center(ids)
+    t_center = time.perf_counter() - t0
+    assert np.isfinite(centers).all()
+
+    for name, secs in (("coord_to_cell", t_coord), ("cell_to_center", t_center)):
+        print(json.dumps({
+            "metric": f"geometry_{name}_queries_per_sec",
+            "value": round(n / secs, 1),
+            "unit": "queries/s",
+        }))
+
+    bounds = [np.linspace(0.0, 64.0, 65) ** 1.1 for _ in range(3)]
+    gs = (
+        Grid()
+        .set_initial_length((64, 64, 64))
+        .set_geometry(StretchedCartesianGeometry, coordinates=bounds)
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    coords = rng.uniform(0.0, float(bounds[0][-1]), size=(n, 3))
+    t0 = time.perf_counter()
+    found = gs.geometry.get_cell(0, coords)
+    t_s = time.perf_counter() - t0
+    assert (found > 0).all()
+    print(json.dumps({
+        "metric": "stretched_geometry_coord_to_cell_queries_per_sec",
+        "value": round(n / t_s, 1),
+        "unit": "queries/s",
+    }))
+
+
+def bench_refinement(length: int):
+    from dccrg_tpu import Grid, make_mesh
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    cells = g.get_cells()
+    t0 = time.perf_counter()
+    for c in cells:
+        g.refine_completely(int(c))
+    created = g.stop_refining()
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "refinement_cells_created_per_sec",
+        "value": round(len(created) / secs, 1),
+        "unit": "cells/s",
+        "detail": {"refined": len(cells), "created": len(created), "secs": round(secs, 3)},
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--refine-length", type=int, default=32)
+    args = ap.parse_args()
+    bench_geometry(args.n)
+    bench_refinement(args.refine_length)
+
+
+if __name__ == "__main__":
+    main()

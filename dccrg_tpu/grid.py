@@ -556,23 +556,29 @@ class Grid:
             return True
         if cell in self.amr.not_to_refine:
             return False
-        ids, _ = self.get_neighbors_of(cell)
-        n_lvl = self.mapping.get_refinement_level(ids)
-        if any(
-            int(n) in self.amr.not_to_refine
-            for n in ids[n_lvl < lvl]
-        ):
-            return False
+        ids = None
+        if self.amr.not_to_refine:
+            ids, _ = self.get_neighbors_of(cell)
+            n_lvl = self.mapping.get_refinement_level(ids)
+            if any(
+                int(n) in self.amr.not_to_refine
+                for n in ids[n_lvl < lvl]
+            ):
+                return False
         self.amr.to_refine.add(cell)
         # cancel conflicting unrefines: own siblings + same-or-coarser
-        # neighbors' siblings
-        for sib in self.mapping.get_siblings(np.uint64(cell)).tolist():
-            self.amr.to_unrefine.discard(sib)
-        both = np.concatenate([ids, self.get_neighbors_to(cell)])
-        for n, nl in zip(both, self.mapping.get_refinement_level(both)):
-            if nl <= lvl:
-                for sib in self.mapping.get_siblings(n).tolist():
-                    self.amr.to_unrefine.discard(sib)
+        # neighbors' siblings (skipped when no unrefines are pending — the
+        # mass-refinement fast path)
+        if self.amr.to_unrefine:
+            if ids is None:
+                ids, _ = self.get_neighbors_of(cell)
+            both = np.concatenate(
+                [[np.uint64(cell)], ids, self.get_neighbors_to(cell)]
+            ).astype(np.uint64)
+            nl = self.mapping.get_refinement_level(both)
+            cand = both[nl <= lvl]
+            sibs = self.mapping.get_siblings(cand).reshape(-1)
+            self.amr.to_unrefine.difference_update(sibs.tolist())
         return True
 
     def unrefine_completely(self, cell) -> bool:

@@ -99,6 +99,41 @@ def test_unrefine_roundtrip():
     check_two_to_one(g)
 
 
+def test_dont_unrefine_veto():
+    """dont_unrefine cancels a pending family unrefine and blocks later
+    requests for any sibling (dccrg.hpp:2679-2784 semantics)."""
+    g = make_grid()
+    g.refine_completely(5)
+    children = g.stop_refining()
+    # veto recorded after the request: cancels it
+    g.unrefine_completely(int(children[0]))
+    assert g.dont_unrefine(int(children[1]))
+    g.stop_refining()
+    assert len(g.get_removed_cells()) == 0
+    assert set(children.tolist()) <= set(g.get_cells().tolist())
+    # veto recorded before the request: request becomes a no-op
+    g.dont_unrefine(int(children[2]))
+    g.unrefine_completely(int(children[3]))
+    g.stop_refining()
+    assert len(g.get_removed_cells()) == 0
+    assert set(children.tolist()) <= set(g.get_cells().tolist())
+    # level-0 cells can never unrefine: dont_unrefine is a trivial success
+    assert g.dont_unrefine(2)
+    # unknown cell: refused
+    assert not g.dont_unrefine(10**9)
+
+
+def test_dont_unrefine_at_coordinates():
+    g = make_grid()
+    g.refine_completely(1)
+    children = g.stop_refining()
+    center = g.geometry.get_center(children[:1])[0]
+    assert g.dont_unrefine_at(center)
+    g.unrefine_completely(int(children[0]))
+    g.stop_refining()
+    assert len(g.get_removed_cells()) == 0
+
+
 def test_unrefine_blocked_by_sibling_refine():
     g = make_grid()
     g.refine_completely(5)

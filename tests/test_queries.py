@@ -10,7 +10,12 @@ from dccrg_tpu.grid import (
     HAS_REMOTE_NEIGHBOR_OF,
     HAS_REMOTE_NEIGHBOR_TO,
 )
-from dccrg_tpu.utils.collectives import all_reduce, halo_peers, some_reduce
+from dccrg_tpu.utils.collectives import (
+    all_gather,
+    all_reduce,
+    halo_peers,
+    some_reduce,
+)
 
 
 @pytest.fixture
@@ -82,7 +87,9 @@ def test_copy_structure(grid):
 
 def test_collectives(grid):
     vals = np.arange(grid.n_devices, dtype=float)
+    assert all_gather(vals) == vals.tolist()
     assert all_reduce(vals) == vals.sum()
+    assert all_reduce(vals, op=np.minimum) == 0.0
     peers = halo_peers(grid, 3)
     assert 2 in peers and 4 in peers
     # neighbor-only reduce covers the device and its peers only
