@@ -102,6 +102,20 @@ def bench_refinement(length: int):
         "detail": {"refined": len(cells), "created": len(created), "secs": round(secs, 3)},
     }))
 
+    leaves = g.get_cells()
+    t0 = time.perf_counter()
+    for c in leaves:
+        g.unrefine_completely(int(c))
+    g.stop_refining()
+    removed = g.get_removed_cells()
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "unrefinement_cells_removed_per_sec",
+        "value": round(len(removed) / secs, 1),
+        "unit": "cells/s",
+        "detail": {"requested": len(leaves), "removed": len(removed), "secs": round(secs, 3)},
+    }))
+
 
 def main():
     ap = argparse.ArgumentParser()

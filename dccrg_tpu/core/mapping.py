@@ -23,6 +23,7 @@ and cross-checking compatibility):
 """
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass, field
 from functools import cached_property
 
@@ -271,6 +272,59 @@ class Mapping:
             out[lvl0, 0] = cells[lvl0] if cells.ndim else cells
         out[~valid] = ERROR_CELL
         return out
+
+    # ------------------------------------------------- scalar fast paths
+    # Python-int versions of the tree ops for per-cell request APIs
+    # (refine/unrefine queues): identical results to the vectorized forms,
+    # ~100x cheaper for a single id than numpy broadcasting.
+
+    @cached_property
+    def _offsets_int(self):
+        return tuple(int(v) for v in self._level_offsets)
+
+    def refinement_level_of(self, cell: int) -> int:
+        """Scalar ``get_refinement_level`` (-1 for invalid ids)."""
+        offs = self._offsets_int
+        if cell < 1 or cell > offs[-1] - 1:
+            return -1
+        return bisect.bisect_right(offs, cell) - 1
+
+    def siblings_of(self, cell: int) -> list:
+        """Scalar ``get_siblings`` as a list of ints (level-0: the cell
+        itself followed by seven ``ERROR_CELL`` entries)."""
+        lvl = self.refinement_level_of(cell)
+        if lvl < 0:
+            return [int(ERROR_CELL)] * 8
+        if lvl == 0:
+            return [cell] + [int(ERROR_CELL)] * 7
+        offs = self._offsets_int
+        local = cell - offs[lvl]
+        lx = self.length[0] << lvl
+        ly = self.length[1] << lvl
+        x, y, z = local % lx, (local // lx) % ly, local // (lx * ly)
+        bx, by, bz = x & ~1, y & ~1, z & ~1
+        base = offs[lvl] + bx + by * lx + bz * lx * ly
+        return [
+            base + dx + dy * lx + dz * lx * ly
+            for dz in (0, 1) for dy in (0, 1) for dx in (0, 1)
+        ]
+
+    def parent_of(self, cell: int) -> int:
+        """Scalar ``get_parent`` (cell itself at level 0, ERROR_CELL if
+        invalid)."""
+        lvl = self.refinement_level_of(cell)
+        if lvl < 0:
+            return int(ERROR_CELL)
+        if lvl == 0:
+            return cell
+        offs = self._offsets_int
+        local = cell - offs[lvl]
+        lx = self.length[0] << lvl
+        ly = self.length[1] << lvl
+        x, y, z = local % lx, (local // lx) % ly, local // (lx * ly)
+        plx = self.length[0] << (lvl - 1)
+        ply = self.length[1] << (lvl - 1)
+        return offs[lvl - 1] + (x >> 1) + (y >> 1) * plx + (z >> 1) * plx * ply
 
     def get_level_0_parent(self, cells) -> np.ndarray:
         """Level-0 ancestor (reference ``dccrg_mapping.hpp:479-493``)."""

@@ -542,7 +542,7 @@ class Grid:
         pos = int(self.leaves.position(np.uint64(cell)))
         if pos < 0:
             return -1
-        return int(self.mapping.get_refinement_level(np.uint64(cell)))
+        return self.mapping.refinement_level_of(int(cell))
 
     def refine_completely(self, cell) -> bool:
         """Queue a cell for refinement into 8 children at the next
@@ -590,17 +590,26 @@ class Grid:
             return False
         if lvl == 0:
             return True
-        siblings = self.mapping.get_siblings(np.uint64(cell))
-        # all siblings must be leaves (no children)
-        if not self.leaves.exists(siblings).all():
-            return False
-        for sib in siblings.tolist():
+        # per-sibling checks in the reference's order: has-children first
+        # (False), then refine-queued/vetoed (True)
+        siblings = self.mapping.siblings_of(cell)
+        is_leaf = self.leaves.exists(np.asarray(siblings, dtype=np.uint64))
+        for sib, leaf in zip(siblings, is_leaf):
+            if not leaf:
+                return False
             if sib in self.amr.to_refine or sib in self.amr.not_to_unrefine:
                 return True
+        # family already queued — hoisted above the expensive
+        # parent-neighborhood search; a queued family always reaches a
+        # True return below (queuing excludes child-bearing/refining/
+        # vetoed siblings within an epoch), so the early exit preserves
+        # the reference's return values
+        if not self.amr.to_unrefine.isdisjoint(siblings):
+            return True
         # parent's would-be neighborhood must not contain too-fine cells
         from .amr.refinement import _find_for_nonleaves
 
-        parent = self.mapping.get_parent(np.uint64(cell))
+        parent = self.mapping.parent_of(cell)
         plists = _find_for_nonleaves(
             self.mapping, self.topology, self.leaves,
             np.asarray([parent], dtype=np.uint64), self.neighborhoods[None],
@@ -612,10 +621,6 @@ class Grid:
         p_lvl = lvl - 1
         for n, nl in zip(self.leaves.cells[pos], n_lvl):
             if nl == p_lvl + 1 and int(n) in self.amr.to_refine:
-                return True
-        # one sibling per family
-        for sib in siblings.tolist():
-            if sib in self.amr.to_unrefine:
                 return True
         self.amr.to_unrefine.add(cell)
         return True
@@ -638,7 +643,7 @@ class Grid:
             return False
         if lvl == 0:
             return True
-        siblings = self.mapping.get_siblings(np.uint64(cell)).tolist()
+        siblings = self.mapping.siblings_of(cell)
         if any(s in self.amr.not_to_unrefine for s in siblings):
             return True
         for s in siblings:

@@ -101,6 +101,25 @@ def test_cell_from_indices_matches_oracle(length, max_ref):
         assert int(got) == oracle_cell_from_indices(length, max_ref, ind, lvl)
 
 
+@pytest.mark.parametrize("length,max_ref", GRIDS)
+def test_scalar_fast_paths_match_vectorized(length, max_ref):
+    """refinement_level_of/siblings_of/parent_of agree with the vectorized
+    tree ops for every valid cell id and for invalid ids."""
+    m = Mapping(length=length, max_refinement_level=max_ref)
+    all_cells = np.arange(1, int(m.last_cell) + 1, dtype=np.uint64)
+    lvl_vec = m.get_refinement_level(all_cells)
+    sib_vec = m.get_siblings(all_cells)
+    par_vec = m.get_parent(all_cells)
+    for i, c in enumerate(all_cells.tolist()):
+        assert m.refinement_level_of(c) == lvl_vec[i]
+        assert m.siblings_of(c) == sib_vec[i].tolist()
+        assert m.parent_of(c) == par_vec[i]
+    for bad in (0, int(m.last_cell) + 1, 2**63):
+        assert m.refinement_level_of(bad) == -1
+        assert m.parent_of(bad) == 0
+        assert m.siblings_of(bad) == [0] * 8
+
+
 def test_invalid_inputs_yield_sentinels():
     m = Mapping(length=(2, 2, 2), max_refinement_level=1)
     last = int(m.last_cell)
