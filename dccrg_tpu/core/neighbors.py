@@ -31,7 +31,42 @@ import numpy as np
 from .mapping import Mapping
 from .topology import Topology
 
-__all__ = ["LeafSet", "NeighborLists", "find_all_neighbors", "invert_neighbors"]
+__all__ = [
+    "LeafSet",
+    "NeighborLists",
+    "find_all_neighbors",
+    "invert_neighbors",
+    "face_directions",
+]
+
+
+def face_directions(off, clen, nlen):
+    """Signed face axis (+-1/2/3 for x/y/z, 0 = not a face neighbor) of
+    neighbor entries from their min-corner offsets — the reference's offset
+    classification (tests/advection/solve.hpp:71-123): overlap in exactly
+    two dimensions plus contact (offset == +cell length or == -neighbor
+    length) in the third.
+
+    ``off`` is ``(..., 3)`` in index units; ``clen``/``nlen`` (cell and
+    neighbor edge lengths in index units) must broadcast to ``off``'s
+    leading shape.  Shared by the flat gather tables
+    (``models/advection.py``) and the boxed layout (``parallel/boxed.py``)
+    so both paths classify the identical face set.
+    """
+    off = np.asarray(off)
+    clen = np.asarray(clen)[..., None]
+    nlen = np.asarray(nlen)[..., None]
+    overlap = (off < clen) & (off > -nlen)
+    n_overlap = overlap.sum(axis=-1)
+    direction = np.zeros(off.shape[:-1], dtype=np.int8)
+    for d in range(3):
+        direction = np.where(
+            (n_overlap == 2) & (off[..., d] == clen[..., 0]), d + 1, direction
+        )
+        direction = np.where(
+            (n_overlap == 2) & (off[..., d] == -nlen[..., 0]), -(d + 1), direction
+        )
+    return direction.astype(np.int8)
 
 
 @dataclass(frozen=True)

@@ -41,13 +41,14 @@ class Advection:
     }
 
     def __init__(self, grid, hood_id=None, dtype=np.float64, allow_dense=True,
-                 use_pallas=True):
+                 use_pallas=True, allow_boxed=True):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
         self.use_pallas = use_pallas
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
         self.dense = grid.epoch.dense if allow_dense else None
+        self.boxed = None
         if self.dense is not None:
             self._init_dense()
             return
@@ -57,6 +58,12 @@ class Advection:
         self._step = self._build_step()
         self._max_dt = self._build_max_dt()
         self._max_diff = self._build_max_diff()
+        if allow_boxed:
+            from ..parallel.boxed import build_boxed
+
+            self.boxed = build_boxed(grid, hood_id)
+            if self.boxed is not None:
+                self._boxed_run = self._build_boxed_run(self.boxed)
 
     # ------------------------------------------------------ static tables
 
@@ -64,6 +71,8 @@ class Advection:
         """Classify each neighbor entry as a face neighbor with a signed
         direction, reproducing the offset logic of
         ``solve.hpp:71-123``: overlap in exactly 2 dims + contact in 1."""
+        from ..core.neighbors import face_directions
+
         epoch = self.grid.epoch
         hood = epoch.hoods[self.hood_id]
         off = hood.nbr_offset.astype(np.int64)          # [D, R, K, 3]
@@ -71,20 +80,9 @@ class Advection:
         clen = epoch.cell_len.astype(np.int64)[..., None]  # [D, R, 1]
         valid = hood.nbr_valid
 
-        overlap = (off < clen[..., None]) & (off > -nlen[..., None])  # per dim
-        pos_contact = off == clen[..., None]
-        neg_contact = off == -nlen[..., None]
-        n_overlap = overlap.sum(axis=-1)
-
-        direction = np.zeros(off.shape[:-1], dtype=np.int8)
-        for d in range(3):
-            axis = d + 1
-            direction = np.where(
-                valid & (n_overlap == 2) & pos_contact[..., d], axis, direction
-            )
-            direction = np.where(
-                valid & (n_overlap == 2) & neg_contact[..., d], -axis, direction
-            )
+        direction = np.where(valid, face_directions(off, clen, nlen), 0).astype(
+            np.int8
+        )
         self.face_dir = direction                        # [D, R, K] signed axis or 0
 
         # physical areas/volumes from geometry tables
@@ -213,6 +211,142 @@ class Advection:
             return {**state, "max_diff": jnp.where(t["local_mask"], md, 0.0)}
 
         return max_diff
+
+    # ------------------------------------------------------ boxed AMR path
+
+    def _build_boxed_run(self, layout):
+        """Multi-step run over the boxed per-level layout
+        (``parallel/boxed.py``): same-level fluxes as masked shifted slices
+        per level box, cross-level fluxes through small padded gather
+        tables.  Velocities are loop-invariant inside a run, so per-face
+        weights and upwind selections are computed once at run start; the
+        loop body touches only density.  Produces the same update as the
+        general gather path (solve.hpp:129-260 semantics) with a different
+        — but fixed — floating-point association order."""
+        dtype = self.dtype
+        boxes = sorted(layout.boxes.values(), key=lambda b: b.level)
+        lvl_index = {b.level: i for i, b in enumerate(boxes)}
+        consts = []
+        for b in boxes:
+            area = np.array(
+                [
+                    b.length[1] * b.length[2],
+                    b.length[0] * b.length[2],
+                    b.length[0] * b.length[1],
+                ]
+            )
+            consts.append(
+                dict(
+                    shape=b.shape,
+                    rows=jnp.asarray(b.rows, jnp.int32),
+                    leaf=jnp.asarray(b.leaf_mask),
+                    face_valid=jnp.asarray(b.face_valid),
+                    area=area.astype(dtype),
+                    inv_vol=dtype(1.0 / float(np.prod(b.length))),
+                    leaf_flat=jnp.asarray(b.leaf_flat, jnp.int32),
+                    leaf_rows=jnp.asarray(b.leaf_rows, jnp.int32),
+                )
+            )
+        gconst = []
+        for g in layout.groups:
+            gconst.append(
+                dict(
+                    ai=lvl_index[g.a_level],
+                    bi=lvl_index[g.b_level],
+                    a_flat=jnp.asarray(g.a_flat, jnp.int32),
+                    b_flat=jnp.asarray(g.b_flat, jnp.int32),
+                    sgn=jnp.asarray(g.sgn.astype(np.float32), dtype),
+                    axis=jnp.asarray(g.axis, jnp.int8),
+                    coeff=jnp.asarray(g.coeff, dtype),
+                    cl=jnp.asarray(g.cl, dtype),
+                    nl=jnp.asarray(g.nl, dtype),
+                )
+            )
+
+        @jax.jit
+        def run(state, steps, dt):
+            dt = jnp.asarray(dt, dtype)
+            rho_f = state["density"][0]
+            v_f = (state["vx"][0], state["vy"][0], state["vz"][0])
+
+            def to_box(flat, c):
+                vals = flat[c["rows"]].reshape(c["shape"])
+                return jnp.where(c["leaf"], vals, 0)
+
+            rhos = tuple(to_box(rho_f, c) for c in consts)
+            vels = [tuple(to_box(v, c) for v in v_f) for c in consts]
+
+            # per-level static face weights (velocity is loop-invariant)
+            weights = []
+            for li, c in enumerate(consts):
+                per_axis = []
+                for d in range(3):
+                    ax = 2 - d  # physics x/y/z -> array axis
+                    v = vels[li][d]
+                    vf = 0.5 * (v + jnp.roll(v, -1, ax))
+                    w = jnp.where(c["face_valid"][d], dt * vf * c["area"][d], 0)
+                    per_axis.append((vf >= 0, w))
+                weights.append(per_axis)
+
+            # per-group static coefficients and upwind selection
+            gstat = []
+            for g in gconst:
+                va = [vels[g["ai"]][d].reshape(-1)[g["a_flat"]] for d in range(3)]
+                vb = [
+                    vels[g["bi"]][d].reshape(-1)[g["b_flat"]] for d in range(3)
+                ]
+                ax = g["axis"]
+                sel = lambda t: jnp.where(
+                    ax == 0, t[0][..., None] if t[0].ndim == 1 else t[0],
+                    jnp.where(ax == 1, t[1][..., None] if t[1].ndim == 1 else t[1],
+                              t[2][..., None] if t[2].ndim == 1 else t[2]),
+                )
+                v_a = sel(va)
+                v_b = sel(vb)
+                v_face = (g["cl"] * v_b + g["nl"] * v_a) / (g["cl"] + g["nl"])
+                upwind_is_a = (v_face >= 0) == (g["sgn"] > 0)
+                full = -g["sgn"] * dt * v_face * g["coeff"]
+                gstat.append((upwind_is_a, full))
+
+            def body(i, rhos):
+                new = []
+                for li, c in enumerate(consts):
+                    rho = rhos[li]
+                    delta = jnp.zeros_like(rho)
+                    for d in range(3):
+                        ax = 2 - d
+                        upsel, w = weights[li][d]
+                        rho_n = jnp.roll(rho, -1, ax)
+                        F = jnp.where(upsel, rho, rho_n) * w
+                        delta = delta + (jnp.roll(F, 1, ax) - F)
+                    new.append(rho + delta * c["inv_vol"])
+                # cross-level corrections, from the *old* densities
+                for g, (upwind_is_a, full) in zip(gconst, gstat):
+                    rho_a = rhos[g["ai"]].reshape(-1)[g["a_flat"]]
+                    rho_b = rhos[g["bi"]].reshape(-1)[g["b_flat"]]
+                    up = jnp.where(upwind_is_a, rho_a[:, None], rho_b)
+                    corr = ordered_sum(full * up, axis=-1)
+                    ai = g["ai"]
+                    new[ai] = (
+                        new[ai]
+                        .reshape(-1)
+                        .at[g["a_flat"]]
+                        .add(corr)
+                        .reshape(consts[ai]["shape"])
+                    )
+                return tuple(new)
+
+            rhos = jax.lax.fori_loop(0, steps, body, rhos)
+            out = rho_f
+            for li, c in enumerate(consts):
+                out = out.at[c["leaf_rows"]].set(rhos[li].reshape(-1)[c["leaf_flat"]])
+            return {
+                **state,
+                "density": out[None],
+                "flux": jnp.zeros_like(state["flux"]),
+            }
+
+        return run
 
     # ------------------------------------------------------ dense fast path
 
@@ -474,6 +608,10 @@ class Advection:
         interleaved with host logic (AMR, load balancing, IO)."""
         if getattr(self, "_fused_run", None) is not None:
             return self._fused_run(
+                state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
+            )
+        if getattr(self, "_boxed_run", None) is not None:
+            return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if not hasattr(self, "_run"):
