@@ -216,13 +216,22 @@ class Advection:
 
     def _build_boxed_run(self, layout):
         """Multi-step run over the boxed per-level layout
-        (``parallel/boxed.py``): same-level fluxes as masked shifted slices
-        per level box, cross-level fluxes through small padded gather
-        tables.  Velocities are loop-invariant inside a run, so per-face
-        weights and upwind selections are computed once at run start; the
-        loop body touches only density.  Produces the same update as the
-        general gather path (solve.hpp:129-260 semantics) with a different
-        — but fixed — floating-point association order."""
+        (``parallel/boxed.py``).  Everything is dense:
+
+        * same-level fluxes: masked shifted slices per level box;
+        * cross-level fluxes: the coarse box is upsampled 2x over the fine
+          box's footprint (one ``jnp.repeat`` window per pair per step), the
+          per-fine-face mass fluxes are computed as masked dense arrays on
+          the fine grid, applied to fine cells directly, and their exact
+          negations reach the coarse receivers by a global-parity-aligned
+          2x sum-pool plus one-cell shift (the octree invariant asserted in
+          ``CrossPair``) — no gathers or scatters anywhere in the loop.
+
+        Velocities are loop-invariant inside a run, so all face weights and
+        upwind selections are computed once at run start; the loop body
+        touches only density.  Produces the same update as the general
+        gather path (solve.hpp:129-260 semantics) with a different — but
+        fixed — floating-point association order."""
         dtype = self.dtype
         boxes = sorted(layout.boxes.values(), key=lambda b: b.level)
         lvl_index = {b.level: i for i, b in enumerate(boxes)}
@@ -247,19 +256,144 @@ class Advection:
                     leaf_rows=jnp.asarray(b.leaf_rows, jnp.int32),
                 )
             )
-        gconst = []
-        for g in layout.groups:
-            gconst.append(
+
+        def _clip(v, lo, hi):
+            return int(min(max(v, lo), hi))
+
+        mapping = self.grid.mapping
+        topology = self.grid.topology
+        periodic = [topology.is_periodic(d) for d in range(3)]
+        pconsts = []
+        for pr in layout.pairs:
+            fb = layout.boxes[pr.fine_level]
+            cb = layout.boxes[pr.coarse_level]
+            lo_f = fb.lo.astype(np.int64)               # (3,) x,y,z fine units
+            lo_c = cb.lo.astype(np.int64)
+            bz, by, bx = fb.shape
+            dims_f = np.array([bx, by, bz])             # x,y,z
+            cz, cy, cx = cb.shape
+            dims_c = np.array([cx, cy, cz])
+            n_c = np.array(mapping.length) << pr.coarse_level  # domain extent
+            # coarse window covering fine box + 1 ring: coords [clo, chi),
+            # wrapped modulo the domain on periodic axes (a refined region
+            # touching a periodic boundary has coarse neighbors across the
+            # wrap); positions with no real neighbor carry garbage that the
+            # face masks zero out
+            clo = (lo_f - 1) >> 1
+            chi = ((lo_f + dims_f) >> 1) + 1
+            win_idx = []
+            for d in range(3):
+                coords = np.arange(clo[d], chi[d])
+                if periodic[d]:
+                    coords = coords % n_c[d]
+                win_idx.append(
+                    np.clip(coords - lo_c[d], 0, dims_c[d] - 1).astype(np.int32)
+                )
+            off = lo_f - 1 - 2 * clo                    # 0/1 per axis
+            # pooling alignment to global-even fine coords
+            plo_pad = [int(lo_f[d] & 1) for d in range(3)]
+            pdims = [
+                (int(dims_f[d]) + plo_pad[d] + 1) // 2 * 2 for d in range(3)
+            ]
+            phi_pad = [pdims[d] - int(dims_f[d]) - plo_pad[d] for d in range(3)]
+            plo = lo_f >> 1                             # pooled coord origin
+            fine_area = np.array(
+                [
+                    fb.length[1] * fb.length[2],
+                    fb.length[0] * fb.length[2],
+                    fb.length[0] * fb.length[1],
+                ]
+            ).astype(dtype)
+
+            def upsample(carr, win_idx=win_idx, off=off, shape=fb.shape):
+                win = carr
+                for a in range(3):
+                    win = jnp.take(win, win_idx[2 - a], axis=a)
+                up = win
+                for a in range(3):
+                    up = jnp.repeat(up, 2, axis=a)
+                bz, by, bx = shape
+                return up[
+                    off[2]:off[2] + bz + 2,
+                    off[1]:off[1] + by + 2,
+                    off[0]:off[0] + bx + 2,
+                ]
+
+            def up_shift(up_pad, d, s, shape=fb.shape):
+                """Value of the coarse neighbor at fine position p + s*e_d."""
+                bz, by, bx = shape
+                st = [1, 1, 1]
+                st[2 - d] += s
+                return up_pad[
+                    st[0]:st[0] + bz, st[1]:st[1] + by, st[2]:st[2] + bx
+                ]
+
+            def pool_add(delta_c, F, d, s, plo_pad=plo_pad, phi_pad=phi_pad,
+                         pdims=pdims, plo=plo, lo_c=lo_c, dims_c=dims_c,
+                         n_c=n_c):
+                """Add the 2x sum-pool of fine-face mass fluxes ``F`` into
+                the coarse delta at pooled position + s*e_d.  The shift can
+                push exactly one pooled plane across a periodic boundary;
+                that plane gets its own slice-add at the wrapped position."""
+                Fp = jnp.pad(
+                    F,
+                    (
+                        (plo_pad[2], phi_pad[2]),
+                        (plo_pad[1], phi_pad[1]),
+                        (plo_pad[0], phi_pad[0]),
+                    ),
+                )
+                nz, ny, nx = pdims[2] // 2, pdims[1] // 2, pdims[0] // 2
+                npool = [nx, ny, nz]
+                P = Fp.reshape(nz, 2, ny, 2, nx, 2).sum(axis=(1, 3, 5))
+                t0 = [int(plo[a] - lo_c[a]) for a in range(3)]
+                t0[d] += s
+
+                def add_block(delta_c, P, t0):
+                    c0 = [_clip(t0[a], 0, dims_c[a]) for a in range(3)]
+                    c1 = [
+                        _clip(t0[a] + P.shape[2 - a], 0, dims_c[a])
+                        for a in range(3)
+                    ]
+                    if any(c1[a] <= c0[a] for a in range(3)):
+                        return delta_c
+                    Ps = P[
+                        c0[2] - t0[2]:c1[2] - t0[2],
+                        c0[1] - t0[1]:c1[1] - t0[1],
+                        c0[0] - t0[0]:c1[0] - t0[0],
+                    ]
+                    return delta_c.at[
+                        c0[2]:c1[2], c0[1]:c1[1], c0[0]:c1[0]
+                    ].add(Ps)
+
+                delta_c = add_block(delta_c, P, t0)
+                if periodic[d]:
+                    ax = 2 - d
+                    g0 = int(plo[d]) + s  # global coord of first pooled plane
+                    if g0 == -1:          # s == -1 wrap: low plane -> domain end
+                        plane = jax.lax.slice_in_dim(P, 0, 1, axis=ax)
+                        tw = list(t0)
+                        tw[d] = int(n_c[d] - 1 - lo_c[d])
+                        delta_c = add_block(delta_c, plane, tw)
+                    if g0 + npool[d] - 1 == n_c[d]:  # s == +1: high plane -> 0
+                        plane = jax.lax.slice_in_dim(
+                            P, npool[d] - 1, npool[d], axis=ax
+                        )
+                        tw = list(t0)
+                        tw[d] = int(0 - lo_c[d])
+                        delta_c = add_block(delta_c, plane, tw)
+                return delta_c
+
+            pconsts.append(
                 dict(
-                    ai=lvl_index[g.a_level],
-                    bi=lvl_index[g.b_level],
-                    a_flat=jnp.asarray(g.a_flat, jnp.int32),
-                    b_flat=jnp.asarray(g.b_flat, jnp.int32),
-                    sgn=jnp.asarray(g.sgn.astype(np.float32), dtype),
-                    axis=jnp.asarray(g.axis, jnp.int8),
-                    coeff=jnp.asarray(g.coeff, dtype),
-                    cl=jnp.asarray(g.cl, dtype),
-                    nl=jnp.asarray(g.nl, dtype),
+                    fi=lvl_index[pr.fine_level],
+                    ci=lvl_index[pr.coarse_level],
+                    mask_plus=jnp.asarray(pr.mask_plus),
+                    mask_minus=jnp.asarray(pr.mask_minus),
+                    area=fine_area,
+                    upsample=upsample,
+                    up_shift=up_shift,
+                    pool_add=pool_add,
                 )
             )
 
@@ -288,28 +422,27 @@ class Advection:
                     per_axis.append((vf >= 0, w))
                 weights.append(per_axis)
 
-            # per-group static coefficients and upwind selection
-            gstat = []
-            for g in gconst:
-                va = [vels[g["ai"]][d].reshape(-1)[g["a_flat"]] for d in range(3)]
-                vb = [
-                    vels[g["bi"]][d].reshape(-1)[g["b_flat"]] for d in range(3)
-                ]
-                ax = g["axis"]
-                sel = lambda t: jnp.where(
-                    ax == 0, t[0][..., None] if t[0].ndim == 1 else t[0],
-                    jnp.where(ax == 1, t[1][..., None] if t[1].ndim == 1 else t[1],
-                              t[2][..., None] if t[2].ndim == 1 else t[2]),
-                )
-                v_a = sel(va)
-                v_b = sel(vb)
-                v_face = (g["cl"] * v_b + g["nl"] * v_a) / (g["cl"] + g["nl"])
-                upwind_is_a = (v_face >= 0) == (g["sgn"] > 0)
-                full = -g["sgn"] * dt * v_face * g["coeff"]
-                gstat.append((upwind_is_a, full))
+            # per-pair static cross-face weights: from the fine cell's side
+            # of the reference interpolation (cl*v_nbr + nl*v_cell)/(cl+nl)
+            # with cl = len_fine and nl = len_coarse = 2*len_fine, v_face
+            # reduces to (2*v_fine + v_coarse)/3
+            pstat = []
+            for p in pconsts:
+                vstat = []
+                for d in range(3):
+                    v_fine = vels[p["fi"]][d]
+                    upv = p["upsample"](vels[p["ci"]][d])
+                    for s, mask in ((1, p["mask_plus"]), (-1, p["mask_minus"])):
+                        v_c = p["up_shift"](upv, d, s)
+                        vf = (2 * v_fine + v_c) / 3
+                        w = jnp.where(mask[d], dt * vf * p["area"][d], 0)
+                        # fine cell is upwind iff sign(v) matches face side
+                        upsel = (vf >= 0) if s > 0 else (vf < 0)
+                        vstat.append((upsel, w))
+                pstat.append(vstat)
 
             def body(i, rhos):
-                new = []
+                deltas = []
                 for li, c in enumerate(consts):
                     rho = rhos[li]
                     delta = jnp.zeros_like(rho)
@@ -319,22 +452,26 @@ class Advection:
                         rho_n = jnp.roll(rho, -1, ax)
                         F = jnp.where(upsel, rho, rho_n) * w
                         delta = delta + (jnp.roll(F, 1, ax) - F)
-                    new.append(rho + delta * c["inv_vol"])
-                # cross-level corrections, from the *old* densities
-                for g, (upwind_is_a, full) in zip(gconst, gstat):
-                    rho_a = rhos[g["ai"]].reshape(-1)[g["a_flat"]]
-                    rho_b = rhos[g["bi"]].reshape(-1)[g["b_flat"]]
-                    up = jnp.where(upwind_is_a, rho_a[:, None], rho_b)
-                    corr = ordered_sum(full * up, axis=-1)
-                    ai = g["ai"]
-                    new[ai] = (
-                        new[ai]
-                        .reshape(-1)
-                        .at[g["a_flat"]]
-                        .add(corr)
-                        .reshape(consts[ai]["shape"])
-                    )
-                return tuple(new)
+                    deltas.append(delta)
+                # cross-level fluxes from the *old* densities
+                for p, vstat in zip(pconsts, pstat):
+                    fi, ci = p["fi"], p["ci"]
+                    rho_fine = rhos[fi]
+                    up = p["upsample"](rhos[ci])
+                    k = 0
+                    for d in range(3):
+                        for s in (1, -1):
+                            upsel, w = vstat[k]
+                            k += 1
+                            rho_c = p["up_shift"](up, d, s)
+                            F = jnp.where(upsel, rho_fine, rho_c) * w
+                            # +face: outflow for the fine cell; -face: inflow
+                            deltas[fi] = deltas[fi] - s * F
+                            deltas[ci] = p["pool_add"](deltas[ci], s * F, d, s)
+                return tuple(
+                    rhos[li] + deltas[li] * c["inv_vol"]
+                    for li, c in enumerate(consts)
+                )
 
             rhos = jax.lax.fori_loop(0, steps, body, rhos)
             out = rho_f

@@ -10,9 +10,13 @@ Berger-Oliger-style decomposition:
 * every refinement level's leaves are scattered into a dense box (the
   bounding box of that level's cells, ``[z, y, x]`` order) — same-level face
   coupling, asymptotically all of the work, becomes masked shifted slices;
-* only cross-level faces (an O(surface) set, |level difference| == 1 by the
-  2:1 invariant) go through small per-cell-padded gather tables with a fixed
-  within-cell entry order, so results stay deterministic.
+* cross-level faces (an O(surface) set, |level difference| == 1 by the 2:1
+  invariant) are ALSO dense: per adjacent level pair, boolean fine-side
+  face masks (``CrossPair``) drive a kernel that upsamples the coarse box
+  2x over the fine box's footprint, computes per-fine-face mass fluxes as
+  masked dense arrays, and routes their exact negations to the coarse
+  receivers by a parity-aligned 2x sum-pool plus one-cell shift — no
+  gathers or scatters anywhere.
 
 Correctness notes:
 
@@ -34,7 +38,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["LevelBox", "InterfaceGroup", "BoxedLayout", "build_boxed"]
+__all__ = ["LevelBox", "CrossPair", "BoxedLayout", "build_boxed"]
 
 _FACE_OFFSETS = np.array(
     [[1, 0, 0], [-1, 0, 0], [0, 1, 0], [0, -1, 0], [0, 0, 1], [0, 0, -1]],
@@ -58,26 +62,31 @@ class LevelBox:
 
 
 @dataclass
-class InterfaceGroup:
-    """Cross-level face entries from level ``a_level`` cells to ``b_level``
-    neighbors, padded per a-cell with a fixed entry order."""
+class CrossPair:
+    """Cross-level faces between adjacent levels, expressed entirely as
+    dense masks on the FINE level's box.
 
-    a_level: int
-    b_level: int
-    a_flat: np.ndarray      # (M,) int64 unique a positions (flat, level-a box)
-    b_flat: np.ndarray      # (M, K) int64 b positions (flat, level-b box; pad 0)
-    sgn: np.ndarray         # (M, K) int8 face direction sign (pad 0; padded
-                            # entries contribute nothing because coeff pads 0)
-    axis: np.ndarray        # (M, K) int8 face axis 0/1/2 (pad 0)
-    coeff: np.ndarray       # (M, K) float64 min_area / volume_a (pad 0)
-    cl: np.ndarray          # (M, K) float64 a's axis length (pad 1)
-    nl: np.ndarray          # (M, K) float64 b's axis length (pad 1)
+    The octree guarantees two structural facts this encoding relies on:
+    |level difference| == 1 across any face (2:1 balance), and a fine cell
+    whose +d neighbor is coarser sits at an odd global fine coordinate
+    along d (its even-side sibling position is occupied by same-or-finer
+    leaves), so ``(p + e_d) >> 1 == (p >> 1) + e_d`` exactly — the coarse
+    receiver of every fine face flux is reachable by a 2x sum-pool plus a
+    one-cell shift, with no gather/scatter.  Both are asserted at build
+    time.
+    """
+
+    fine_level: int
+    coarse_level: int
+    mask_plus: np.ndarray   # (3, bz, by, bx) bool: fine cell has a coarser
+                            # neighbor across its +x/+y/+z face
+    mask_minus: np.ndarray  # (3, bz, by, bx) bool: same for -x/-y/-z faces
 
 
 @dataclass
 class BoxedLayout:
     boxes: dict             # level -> LevelBox
-    groups: list            # [InterfaceGroup]
+    pairs: list             # [CrossPair]
     n_cells: int            # total leaves covered
 
 
@@ -174,60 +183,42 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
         fv = box.face_valid
         fv[d, pa[:, 2], pa[:, 1], pa[:, 0]] = True
 
-    # ---- cross-level faces -> padded per-cell groups
-    groups: list[InterfaceGroup] = []
+    # ---- cross-level faces -> dense fine-side masks per adjacent pair
+    pairs: list[CrossPair] = []
     cross = np.flatnonzero(face & (la != lb))
     if len(cross):
-        ga, gb = la[cross], lb[cross]
-        for (A, B) in sorted({(int(a), int(b)) for a, b in zip(ga, gb)}):
-            sel = cross[(ga == A) & (gb == B)]
-            abox, bbox = boxes[A], boxes[B]
-            pa = (idx_all[src[sel]] >> (L - A)) - abox.lo
-            pb = (idx_all[lists.nbr_pos[sel]] >> (L - B)) - bbox.lo
-            az, ay, ax = abox.shape
-            bz, by, bx = bbox.shape
-            afl = (pa[:, 2] * ay + pa[:, 1]) * ax + pa[:, 0]
-            bfl = (pb[:, 2] * by + pb[:, 1]) * bx + pb[:, 0]
-            sg = np.sign(direction[sel]).astype(np.int8)
-            axd = (np.abs(direction[sel]) - 1).astype(np.int8)
-            fine = max(A, B)
-            flen = level0_len / (1 << fine)
-            # min(face areas) == the finer side's face area per axis
-            area = np.empty(len(sel), dtype=np.float64)
-            for d in range(3):
-                o = [i for i in range(3) if i != d]
-                area[axd == d] = flen[o[0]] * flen[o[1]]
-            vol_a = float(np.prod(level0_len / (1 << A)))
-            cl = (level0_len / (1 << A))[axd]
-            nl = (level0_len / (1 << B))[axd]
-            # deterministic entry order: by a cell, then axis, sign, b pos
-            order = np.lexsort((bfl, sg, axd, afl))
-            afl, bfl, sg, axd = afl[order], bfl[order], sg[order], axd[order]
-            area, cl, nl = area[order], cl[order], nl[order]
-            a_u, start = np.unique(afl, return_index=True)
-            cnt = np.diff(np.concatenate((start, [len(afl)])))
-            K = int(cnt.max())
-            M = len(a_u)
-            col = np.arange(len(afl)) - np.repeat(start, cnt)
-            rowi = np.repeat(np.arange(M), cnt)
-
-            def pad(vals, fill, dtype):
-                out = np.full((M, K), fill, dtype=dtype)
-                out[rowi, col] = vals
-                return out
-
-            groups.append(
-                InterfaceGroup(
-                    a_level=A,
-                    b_level=B,
-                    a_flat=a_u.astype(np.int64),
-                    b_flat=pad(bfl, 0, np.int64),
-                    sgn=pad(sg, 0, np.int8),
-                    axis=pad(axd, 0, np.int8),
-                    coeff=pad(area / vol_a, 0.0, np.float64),
-                    cl=pad(cl, 1.0, np.float64),
-                    nl=pad(nl, 1.0, np.float64),
+        if (np.abs(la[cross] - lb[cross]) != 1).any():
+            return None  # 2:1 balance violated; not representable here
+        # keep only the fine-side entries; the coarse side is the exact
+        # mirror and is served by pooling the fine-side fluxes
+        fine_e = cross[la[cross] > lb[cross]]
+        coarse_e = cross[la[cross] < lb[cross]]
+        if len(fine_e) != len(coarse_e):
+            return None
+        for F in sorted({int(v) for v in la[fine_e]}):
+            sel = fine_e[la[fine_e] == F]
+            fbox = boxes[F]
+            shift = L - F
+            p_glob = idx_all[src[sel]] >> shift         # global fine coords
+            q = p_glob - fbox.lo
+            d = (np.abs(direction[sel]) - 1).astype(np.int64)
+            plus = direction[sel] > 0
+            # octree parity invariant (see CrossPair docstring)
+            par = p_glob[np.arange(len(sel)), d] & 1
+            if not ((par[plus] == 1).all() and (par[~plus] == 0).all()):
+                return None
+            bz, by, bx = fbox.shape
+            mask_plus = np.zeros((3, bz, by, bx), dtype=bool)
+            mask_minus = np.zeros((3, bz, by, bx), dtype=bool)
+            mask_plus[d[plus], q[plus, 2], q[plus, 1], q[plus, 0]] = True
+            mask_minus[d[~plus], q[~plus, 2], q[~plus, 1], q[~plus, 0]] = True
+            pairs.append(
+                CrossPair(
+                    fine_level=F,
+                    coarse_level=F - 1,
+                    mask_plus=mask_plus,
+                    mask_minus=mask_minus,
                 )
             )
 
-    return BoxedLayout(boxes=boxes, groups=groups, n_cells=N)
+    return BoxedLayout(boxes=boxes, pairs=pairs, n_cells=N)

@@ -87,7 +87,22 @@ def test_boxed_matches_flat_full_3d_velocity():
 
 def test_boxed_matches_flat_refined_periodic():
     adv = _compare(_grid(n=8, maxref=1))
-    assert len(adv.boxed.groups) == 2  # 0->1 and 1->0 faces
+    assert len(adv.boxed.pairs) == 1  # one adjacent level pair (1 | 0)
+
+
+def test_boxed_matches_flat_wrap_corner():
+    # refined region spanning the periodic corner: cross-level faces wrap
+    # in every axis, exercising the wrapped upsample window and the
+    # wrapped pooled-plane adds
+    _compare(_grid(n=8, maxref=1, refine_center=(0.0, 0.0, 0.0), radii=(0.3,)),
+             steps=12)
+
+
+def test_boxed_matches_flat_wrap_high_edge():
+    # refined region at the HIGH domain corner: the last pooled plane wraps
+    # to coarse coordinate 0 (the s == +1 wrap branch of pool_add)
+    _compare(_grid(n=8, maxref=1, refine_center=(1.0, 1.0, 1.0), radii=(0.3,)),
+             steps=12)
 
 
 def test_boxed_matches_flat_refined_nonperiodic():
@@ -98,6 +113,10 @@ def test_boxed_matches_flat_two_levels():
     adv = _compare(_grid(n=8, maxref=2, radii=(0.3, 0.15)))
     levels = sorted(adv.boxed.boxes)
     assert levels == [0, 1, 2]
+    assert sorted((p.fine_level, p.coarse_level) for p in adv.boxed.pairs) == [
+        (1, 0),
+        (2, 1),
+    ]
 
 
 def test_boxed_uniform_single_level():
@@ -105,7 +124,7 @@ def test_boxed_uniform_single_level():
     # no interface groups, pure dense rolls
     g = _grid(n=6, maxref=1, radii=())
     adv = _compare(g)
-    assert len(adv.boxed.groups) == 0
+    assert len(adv.boxed.pairs) == 0
     assert list(adv.boxed.boxes) == [0]
 
 
