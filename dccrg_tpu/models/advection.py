@@ -216,27 +216,92 @@ class Advection:
 
     def _build_boxed_run(self, layout):
         """Multi-step run over the boxed per-level layout
-        (``parallel/boxed.py``).  Everything is dense:
+        (``parallel/boxed.py``).  One unified dense pass per level:
 
-        * same-level fluxes: masked shifted slices per level box;
-        * cross-level fluxes: the coarse box is upsampled 2x over the fine
-          box's footprint (one ``jnp.repeat`` window per pair per step), the
-          per-fine-face mass fluxes are computed as masked dense arrays on
-          the fine grid, applied to fine cells directly, and their exact
-          negations reach the coarse receivers by a global-parity-aligned
-          2x sum-pool plus one-cell shift (the octree invariant asserted in
-          ``CrossPair``) — no gathers or scatters anywhere in the loop.
+        Each level's box is extended by a one-voxel ring ([bz+2, by+2,
+        bx+2]); every voxel carries a value ``val = use_rho ? rho :
+        upsampled-coarse`` where ``use_rho`` marks voxels holding a leaf of
+        this level (wrap copies included on periodic fully-covered axes).
+        A single per-axis upwind flux pass over ``val`` with combined
+        static weights then prices same-level AND coarse|fine faces
+        together: at a cross face one operand is automatically the
+        upsampled coarse value, and the 2:1 face velocity
+        ``(2*v_fine + v_coarse)/3`` (the reference interpolation
+        ``(cl*v_nbr + nl*v_cell)/(cl+nl)`` with ``nl == 2*cl``) is baked
+        into the weight.  Fine cells read their own deltas directly; the
+        deltas accumulated on NON-leaf voxels are exactly the coarse
+        receivers' mass fluxes, recovered by one parity-aligned 2x
+        sum-pool per pair (octree invariant asserted in ``CrossPair``)
+        with modulo folding for periodic wrap — no gathers or scatters in
+        the loop.
 
-        Velocities are loop-invariant inside a run, so all face weights and
+        Velocities are loop-invariant inside a run, so all weights and
         upwind selections are computed once at run start; the loop body
         touches only density.  Produces the same update as the general
         gather path (solve.hpp:129-260 semantics) with a different — but
         fixed — floating-point association order."""
         dtype = self.dtype
+        mapping = self.grid.mapping
+        topology = self.grid.topology
+        periodic = [topology.is_periodic(d) for d in range(3)]
         boxes = sorted(layout.boxes.values(), key=lambda b: b.level)
         lvl_index = {b.level: i for i, b in enumerate(boxes)}
+        pair_of_fine = {pr.fine_level: pr for pr in layout.pairs}
+
+        def _clip(v, lo, hi):
+            return int(min(max(v, lo), hi))
+
         consts = []
         for b in boxes:
+            lvl = b.level
+            lo = b.lo.astype(np.int64)                  # (3,) x,y,z level units
+            bz, by, bx = b.shape
+            dims = np.array([bx, by, bz])
+            n_dom = np.array(mapping.length) << lvl     # domain extent, x,y,z
+            covers = [
+                bool(periodic[d] and lo[d] == 0 and dims[d] == n_dom[d])
+                for d in range(3)
+            ]
+            # ring-padded static masks; np.pad per axis: wrap on covered
+            # periodic axes (ring = copies of the opposite edge), else zero
+            def ring_pad(arr, fill=False):
+                out = arr
+                for a in range(3):
+                    pw = [(0, 0)] * out.ndim
+                    pw[a] = (1, 1)
+                    if covers[2 - a]:
+                        out = np.pad(out, pw, mode="wrap")
+                    else:
+                        out = np.pad(out, pw, mode="constant",
+                                     constant_values=fill)
+                return out
+
+            use_rho = ring_pad(b.leaf_mask)
+            m_same = np.stack([ring_pad(b.face_valid[d]) for d in range(3)])
+            # cross-face masks on the ring-padded grid: low side fine
+            # (mask_plus at the fine voxel) or high side fine (mask_minus,
+            # registered at the coarse voxel p - e_d, which may be ring)
+            m_cross_lowf = np.zeros((3,) + use_rho.shape, dtype=bool)
+            m_cross_highf = np.zeros((3,) + use_rho.shape, dtype=bool)
+            pr = pair_of_fine.get(lvl)
+            if pr is not None:
+                inner = (slice(1, 1 + bz), slice(1, 1 + by), slice(1, 1 + bx))
+                for d in range(3):
+                    m_cross_lowf[d][inner] = pr.mask_plus[d]
+                    # shift mask_minus to the low-side voxel along axis d
+                    ax = 2 - d
+                    sl = [slice(1, 1 + bz), slice(1, 1 + by), slice(1, 1 + bx)]
+                    sl[ax] = slice(0, sl[ax].stop - 1)
+                    m_cross_highf[d][tuple(sl)] = pr.mask_minus[d]
+            # no face may pair the last ring voxel with the (rolled) first
+            for d in range(3):
+                ax = 2 - d
+                sl = [slice(None)] * 3
+                sl[ax] = slice(-1, None)
+                m_same[d][tuple(sl)] = False
+                m_cross_lowf[d][tuple(sl)] = False
+                m_cross_highf[d][tuple(sl)] = False
+
             area = np.array(
                 [
                     b.length[1] * b.length[2],
@@ -246,10 +311,19 @@ class Advection:
             )
             consts.append(
                 dict(
+                    level=lvl,
+                    lo=lo,
                     shape=b.shape,
+                    covers=covers,
+                    n_dom=n_dom,
                     rows=jnp.asarray(b.rows, jnp.int32),
                     leaf=jnp.asarray(b.leaf_mask),
-                    face_valid=jnp.asarray(b.face_valid),
+                    use_rho=jnp.asarray(use_rho),
+                    m_same=jnp.asarray(m_same),
+                    m_cross_lowf=jnp.asarray(m_cross_lowf),
+                    m_cross_highf=jnp.asarray(m_cross_highf),
+                    any_face=jnp.asarray(m_same | m_cross_lowf | m_cross_highf),
+                    pool_mask=jnp.asarray(~use_rho),
                     area=area.astype(dtype),
                     inv_vol=dtype(1.0 / float(np.prod(b.length))),
                     leaf_flat=jnp.asarray(b.leaf_flat, jnp.int32),
@@ -257,28 +331,23 @@ class Advection:
                 )
             )
 
-        def _clip(v, lo, hi):
-            return int(min(max(v, lo), hi))
-
-        mapping = self.grid.mapping
-        topology = self.grid.topology
-        periodic = [topology.is_periodic(d) for d in range(3)]
-        pconsts = []
+        # ---- per-pair static plumbing: the coarse window feeding the fine
+        # ring grid, and the pooled-delta routing back into the coarse box
+        pconsts = {}
         for pr in layout.pairs:
             fb = layout.boxes[pr.fine_level]
             cb = layout.boxes[pr.coarse_level]
-            lo_f = fb.lo.astype(np.int64)               # (3,) x,y,z fine units
+            fi, ci = lvl_index[pr.fine_level], lvl_index[pr.coarse_level]
+            lo_f = fb.lo.astype(np.int64)
             lo_c = cb.lo.astype(np.int64)
             bz, by, bx = fb.shape
-            dims_f = np.array([bx, by, bz])             # x,y,z
+            dims_f = np.array([bx, by, bz])
             cz, cy, cx = cb.shape
             dims_c = np.array([cx, cy, cz])
-            n_c = np.array(mapping.length) << pr.coarse_level  # domain extent
-            # coarse window covering fine box + 1 ring: coords [clo, chi),
-            # wrapped modulo the domain on periodic axes (a refined region
-            # touching a periodic boundary has coarse neighbors across the
-            # wrap); positions with no real neighbor carry garbage that the
-            # face masks zero out
+            n_c = np.array(mapping.length) << pr.coarse_level
+            # coarse window covering the ring grid: coords [clo, chi),
+            # wrapped modulo the domain on periodic axes; positions with no
+            # real neighbor carry garbage that the face masks zero out
             clo = (lo_f - 1) >> 1
             chi = ((lo_f + dims_f) >> 1) + 1
             win_idx = []
@@ -290,20 +359,6 @@ class Advection:
                     np.clip(coords - lo_c[d], 0, dims_c[d] - 1).astype(np.int32)
                 )
             off = lo_f - 1 - 2 * clo                    # 0/1 per axis
-            # pooling alignment to global-even fine coords
-            plo_pad = [int(lo_f[d] & 1) for d in range(3)]
-            pdims = [
-                (int(dims_f[d]) + plo_pad[d] + 1) // 2 * 2 for d in range(3)
-            ]
-            phi_pad = [pdims[d] - int(dims_f[d]) - plo_pad[d] for d in range(3)]
-            plo = lo_f >> 1                             # pooled coord origin
-            fine_area = np.array(
-                [
-                    fb.length[1] * fb.length[2],
-                    fb.length[0] * fb.length[2],
-                    fb.length[0] * fb.length[1],
-                ]
-            ).astype(dtype)
 
             def upsample(carr, win_idx=win_idx, off=off, shape=fb.shape):
                 win = carr
@@ -319,164 +374,178 @@ class Advection:
                     off[0]:off[0] + bx + 2,
                 ]
 
-            def up_shift(up_pad, d, s, shape=fb.shape):
-                """Value of the coarse neighbor at fine position p + s*e_d."""
-                bz, by, bx = shape
-                st = [1, 1, 1]
-                st[2 - d] += s
-                return up_pad[
-                    st[0]:st[0] + bz, st[1]:st[1] + by, st[2]:st[2] + bx
-                ]
+            # pooling of the ring grid: pad to global-even alignment of the
+            # ring origin lo_f - 1, 2x sum-pool, then route pooled planes to
+            # coarse coords (modulo folding on periodic axes)
+            go = lo_f - 1
+            plo_pad = [int(go[d] & 1) for d in range(3)]
+            psz = [int(dims_f[d]) + 2 + plo_pad[d] for d in range(3)]
+            phi_pad = [psz[d] % 2 for d in range(3)]
+            npool = [(psz[d] + phi_pad[d]) // 2 for d in range(3)]
+            cplo = go >> 1                               # pooled coord origin
 
-            def pool_add(delta_c, F, d, s, plo_pad=plo_pad, phi_pad=phi_pad,
-                         pdims=pdims, plo=plo, lo_c=lo_c, dims_c=dims_c,
-                         n_c=n_c):
-                """Add the 2x sum-pool of fine-face mass fluxes ``F`` into
-                the coarse delta at pooled position + s*e_d.  The shift can
-                push exactly one pooled plane across a periodic boundary;
-                that plane gets its own slice-add at the wrapped position."""
-                Fp = jnp.pad(
-                    F,
+            # per-axis routing: main contiguous block + wrapped edge rows
+            routes = []                                  # per axis
+            for d in range(3):
+                g = cplo[d] + np.arange(npool[d])
+                if periodic[d]:
+                    gm = g % n_c[d]
+                else:
+                    gm = g
+                inside = (gm >= 0) & (gm < n_c[d])
+                main = (g >= 0) & (g < n_c[d])
+                wrap_rows = [
+                    (int(i), int(gm[i]))
+                    for i in np.flatnonzero(inside & ~main)
+                ]
+                i0 = int(np.argmax(main)) if main.any() else 0
+                i1 = int(len(g) - np.argmax(main[::-1])) if main.any() else 0
+                routes.append(dict(i0=i0, i1=i1, g0=int(g[i0]) if main.any()
+                                   else 0, wrap_rows=wrap_rows))
+
+            def pool_route(delta_c_pad, P_src, plo_pad=plo_pad,
+                           phi_pad=phi_pad, npool=npool, routes=routes,
+                           lo_c=lo_c, dims_c=dims_c):
+                """2x sum-pool the masked ring-grid deltas and add them into
+                the coarse level's (ring-padded) delta."""
+                Pp = jnp.pad(
+                    P_src,
                     (
                         (plo_pad[2], phi_pad[2]),
                         (plo_pad[1], phi_pad[1]),
                         (plo_pad[0], phi_pad[0]),
                     ),
                 )
-                nz, ny, nx = pdims[2] // 2, pdims[1] // 2, pdims[0] // 2
-                npool = [nx, ny, nz]
-                P = Fp.reshape(nz, 2, ny, 2, nx, 2).sum(axis=(1, 3, 5))
-                t0 = [int(plo[a] - lo_c[a]) for a in range(3)]
-                t0[d] += s
-
-                def add_block(delta_c, P, t0):
-                    c0 = [_clip(t0[a], 0, dims_c[a]) for a in range(3)]
-                    c1 = [
-                        _clip(t0[a] + P.shape[2 - a], 0, dims_c[a])
-                        for a in range(3)
-                    ]
-                    if any(c1[a] <= c0[a] for a in range(3)):
-                        return delta_c
-                    Ps = P[
-                        c0[2] - t0[2]:c1[2] - t0[2],
-                        c0[1] - t0[1]:c1[1] - t0[1],
-                        c0[0] - t0[0]:c1[0] - t0[0],
-                    ]
-                    return delta_c.at[
-                        c0[2]:c1[2], c0[1]:c1[1], c0[0]:c1[0]
-                    ].add(Ps)
-
-                delta_c = add_block(delta_c, P, t0)
-                if periodic[d]:
+                P = Pp.reshape(
+                    npool[2], 2, npool[1], 2, npool[0], 2
+                ).sum(axis=(1, 3, 5))
+                # fold wrapped edge rows into their modulo image, per axis
+                for d in range(3):
                     ax = 2 - d
-                    g0 = int(plo[d]) + s  # global coord of first pooled plane
-                    if g0 == -1:          # s == -1 wrap: low plane -> domain end
-                        plane = jax.lax.slice_in_dim(P, 0, 1, axis=ax)
-                        tw = list(t0)
-                        tw[d] = int(n_c[d] - 1 - lo_c[d])
-                        delta_c = add_block(delta_c, plane, tw)
-                    if g0 + npool[d] - 1 == n_c[d]:  # s == +1: high plane -> 0
-                        plane = jax.lax.slice_in_dim(
-                            P, npool[d] - 1, npool[d], axis=ax
-                        )
-                        tw = list(t0)
-                        tw[d] = int(0 - lo_c[d])
-                        delta_c = add_block(delta_c, plane, tw)
-                return delta_c
+                    r = routes[d]
+                    main = jax.lax.slice_in_dim(P, r["i0"], r["i1"], axis=ax)
+                    for i, gtar in r["wrap_rows"]:
+                        j = gtar - r["g0"]               # row inside main
+                        if 0 <= j < r["i1"] - r["i0"]:
+                            row = jax.lax.slice_in_dim(P, i, i + 1, axis=ax)
+                            sl = [slice(None)] * 3
+                            sl[ax] = slice(j, j + 1)
+                            main = main.at[tuple(sl)].add(row)
+                    P = main
+                # one slice-add into the coarse ring grid (interior offset +1)
+                t0 = [routes[d]["g0"] - int(lo_c[d]) for d in range(3)]
+                c0 = [_clip(t0[d], 0, dims_c[d]) for d in range(3)]
+                c1 = [
+                    _clip(t0[d] + P.shape[2 - d], 0, dims_c[d])
+                    for d in range(3)
+                ]
+                if any(c1[d] <= c0[d] for d in range(3)):
+                    return delta_c_pad
+                Ps = P[
+                    c0[2] - t0[2]:c1[2] - t0[2],
+                    c0[1] - t0[1]:c1[1] - t0[1],
+                    c0[0] - t0[0]:c1[0] - t0[0],
+                ]
+                return delta_c_pad.at[
+                    1 + c0[2]:1 + c1[2], 1 + c0[1]:1 + c1[1],
+                    1 + c0[0]:1 + c1[0],
+                ].add(Ps)
 
-            pconsts.append(
-                dict(
-                    fi=lvl_index[pr.fine_level],
-                    ci=lvl_index[pr.coarse_level],
-                    mask_plus=jnp.asarray(pr.mask_plus),
-                    mask_minus=jnp.asarray(pr.mask_minus),
-                    area=fine_area,
-                    upsample=upsample,
-                    up_shift=up_shift,
-                    pool_add=pool_add,
-                )
-            )
+            pconsts[fi] = dict(ci=ci, upsample=upsample, pool_route=pool_route)
 
         @jax.jit
         def run(state, steps, dt):
             dt = jnp.asarray(dt, dtype)
-            rho_f = state["density"][0]
-            v_f = (state["vx"][0], state["vy"][0], state["vz"][0])
+            rho_flat = state["density"][0]
+            v_flat = (state["vx"][0], state["vy"][0], state["vz"][0])
 
             def to_box(flat, c):
                 vals = flat[c["rows"]].reshape(c["shape"])
                 return jnp.where(c["leaf"], vals, 0)
 
-            rhos = tuple(to_box(rho_f, c) for c in consts)
-            vels = [tuple(to_box(v, c) for v in v_f) for c in consts]
+            def ring(arr, c):
+                """Ring-pad a box array: wrap on covered periodic axes."""
+                out = arr
+                for a in range(3):
+                    pw = [(0, 0)] * 3
+                    pw[a] = (1, 1)
+                    mode = "wrap" if c["covers"][2 - a] else "constant"
+                    out = jnp.pad(out, pw, mode=mode)
+                return out
 
-            # per-level static face weights (velocity is loop-invariant)
-            weights = []
+            rhos = tuple(to_box(rho_flat, c) for c in consts)
+            vels = [tuple(to_box(v, c) for v in v_flat) for c in consts]
+
+            # static per-level face weights and upwind selections
+            stat = []
             for li, c in enumerate(consts):
+                p = pconsts.get(li)
+                ups = (
+                    [p["upsample"](vels[p["ci"]][d]) for d in range(3)]
+                    if p is not None
+                    else [jnp.zeros(c["use_rho"].shape, dtype)] * 3
+                )
                 per_axis = []
                 for d in range(3):
-                    ax = 2 - d  # physics x/y/z -> array axis
-                    v = vels[li][d]
-                    vf = 0.5 * (v + jnp.roll(v, -1, ax))
-                    w = jnp.where(c["face_valid"][d], dt * vf * c["area"][d], 0)
-                    per_axis.append((vf >= 0, w))
-                weights.append(per_axis)
-
-            # per-pair static cross-face weights: from the fine cell's side
-            # of the reference interpolation (cl*v_nbr + nl*v_cell)/(cl+nl)
-            # with cl = len_fine and nl = len_coarse = 2*len_fine, v_face
-            # reduces to (2*v_fine + v_coarse)/3
-            pstat = []
-            for p in pconsts:
-                vstat = []
-                for d in range(3):
-                    v_fine = vels[p["fi"]][d]
-                    upv = p["upsample"](vels[p["ci"]][d])
-                    for s, mask in ((1, p["mask_plus"]), (-1, p["mask_minus"])):
-                        v_c = p["up_shift"](upv, d, s)
-                        vf = (2 * v_fine + v_c) / 3
-                        w = jnp.where(mask[d], dt * vf * p["area"][d], 0)
-                        # fine cell is upwind iff sign(v) matches face side
-                        upsel = (vf >= 0) if s > 0 else (vf < 0)
-                        vstat.append((upsel, w))
-                pstat.append(vstat)
+                    ax = 2 - d
+                    v_val = jnp.where(
+                        c["use_rho"], ring(vels[li][d], c), ups[d]
+                    )
+                    vl, vh = v_val, jnp.roll(v_val, -1, ax)
+                    v_face = jnp.where(
+                        c["m_same"][d], 0.5 * (vl + vh),
+                        jnp.where(
+                            c["m_cross_lowf"][d], (2 * vl + vh) / 3,
+                            (vl + 2 * vh) / 3,
+                        ),
+                    )
+                    w = jnp.where(
+                        c["any_face"][d], dt * v_face * c["area"][d], 0
+                    )
+                    per_axis.append((v_face >= 0, w))
+                stat.append(per_axis)
 
             def body(i, rhos):
                 deltas = []
                 for li, c in enumerate(consts):
-                    rho = rhos[li]
-                    delta = jnp.zeros_like(rho)
+                    p = pconsts.get(li)
+                    val = ring(rhos[li], c)
+                    if p is not None:
+                        val = jnp.where(
+                            c["use_rho"], val, p["upsample"](rhos[p["ci"]])
+                        )
+                    delta = jnp.zeros_like(val)
                     for d in range(3):
                         ax = 2 - d
-                        upsel, w = weights[li][d]
-                        rho_n = jnp.roll(rho, -1, ax)
-                        F = jnp.where(upsel, rho, rho_n) * w
+                        upsel, w = stat[li][d]
+                        F = jnp.where(upsel, val, jnp.roll(val, -1, ax)) * w
                         delta = delta + (jnp.roll(F, 1, ax) - F)
                     deltas.append(delta)
-                # cross-level fluxes from the *old* densities
-                for p, vstat in zip(pconsts, pstat):
-                    fi, ci = p["fi"], p["ci"]
-                    rho_fine = rhos[fi]
-                    up = p["upsample"](rhos[ci])
-                    k = 0
-                    for d in range(3):
-                        for s in (1, -1):
-                            upsel, w = vstat[k]
-                            k += 1
-                            rho_c = p["up_shift"](up, d, s)
-                            F = jnp.where(upsel, rho_fine, rho_c) * w
-                            # +face: outflow for the fine cell; -face: inflow
-                            deltas[fi] = deltas[fi] - s * F
-                            deltas[ci] = p["pool_add"](deltas[ci], s * F, d, s)
-                return tuple(
-                    rhos[li] + deltas[li] * c["inv_vol"]
-                    for li, c in enumerate(consts)
-                )
+                # route non-leaf voxel deltas (= coarse receivers' fluxes)
+                # fine-to-coarse, finest level first
+                for li in range(len(consts) - 1, -1, -1):
+                    p = pconsts.get(li)
+                    if p is None:
+                        continue
+                    deltas[p["ci"]] = p["pool_route"](
+                        deltas[p["ci"]], deltas[li] * consts[li]["pool_mask"]
+                    )
+                new = []
+                for li, c in enumerate(consts):
+                    d_in = deltas[li][1:-1, 1:-1, 1:-1]
+                    new.append(
+                        jnp.where(
+                            c["leaf"], rhos[li] + d_in * c["inv_vol"], 0
+                        )
+                    )
+                return tuple(new)
 
             rhos = jax.lax.fori_loop(0, steps, body, rhos)
-            out = rho_f
+            out = rho_flat
             for li, c in enumerate(consts):
-                out = out.at[c["leaf_rows"]].set(rhos[li].reshape(-1)[c["leaf_flat"]])
+                out = out.at[c["leaf_rows"]].set(
+                    rhos[li].reshape(-1)[c["leaf_flat"]]
+                )
             return {
                 **state,
                 "density": out[None],
