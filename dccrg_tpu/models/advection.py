@@ -7,8 +7,11 @@ TPU-native formulation: instead of the reference's per-cell loop that
 scatters flux into both cells of each face pair (skipping local negative
 directions), every cell accumulates its *own* flux from all of its
 face-neighbor entries in fixed slot order.  That makes the kernel a pure
-gather + masked reduction — deterministic (bit-identical across device
-counts) and scatter-free — at the cost of computing each face's flux twice,
+gather + masked reduction — deterministic (fixed left-to-right flux
+association via ``ordered_sum``; halo copies are bit-exact, and results
+across device counts agree to the last ulp, where the residual is XLA
+instruction selection varying with local array shapes, not data flow) and
+scatter-free — at the cost of computing each face's flux twice,
 which on TPU is free relative to the HBM traffic.
 
 Face classification (direction, shared area, volumes) depends only on grid
@@ -384,8 +387,14 @@ class Advection:
             npool = [(psz[d] + phi_pad[d]) // 2 for d in range(3)]
             cplo = go >> 1                               # pooled coord origin
 
-            # per-axis routing: main contiguous block + wrapped edge rows
-            routes = []                                  # per axis
+            # per-axis routing: contiguous segments of pooled rows that map
+            # to contiguous coarse coordinates under modulo wrap — the main
+            # in-domain block plus one single-row segment per wrapped edge
+            # row.  A wrap target may land *inside* or *outside* the main
+            # block (a box touching but not covering a periodic axis wraps
+            # to the far side of the domain); either way its segment gets
+            # its own slice-add, so no pooled flux is ever dropped.
+            segments = []                                # per axis: (i0, i1, g)
             for d in range(3):
                 g = cplo[d] + np.arange(npool[d])
                 if periodic[d]:
@@ -394,20 +403,23 @@ class Advection:
                     gm = g
                 inside = (gm >= 0) & (gm < n_c[d])
                 main = (g >= 0) & (g < n_c[d])
-                wrap_rows = [
-                    (int(i), int(gm[i]))
-                    for i in np.flatnonzero(inside & ~main)
-                ]
-                i0 = int(np.argmax(main)) if main.any() else 0
-                i1 = int(len(g) - np.argmax(main[::-1])) if main.any() else 0
-                routes.append(dict(i0=i0, i1=i1, g0=int(g[i0]) if main.any()
-                                   else 0, wrap_rows=wrap_rows))
+                segs = []
+                if main.any():
+                    i0 = int(np.argmax(main))
+                    i1 = int(len(g) - np.argmax(main[::-1]))
+                    segs.append((i0, i1, int(g[i0])))
+                for i in np.flatnonzero(inside & ~main):
+                    segs.append((int(i), int(i) + 1, int(gm[i])))
+                segments.append(segs)
 
             def pool_route(delta_c_pad, P_src, plo_pad=plo_pad,
-                           phi_pad=phi_pad, npool=npool, routes=routes,
+                           phi_pad=phi_pad, npool=npool, segments=segments,
                            lo_c=lo_c, dims_c=dims_c):
                 """2x sum-pool the masked ring-grid deltas and add them into
-                the coarse level's (ring-padded) delta."""
+                the coarse level's (ring-padded) delta, one slice-add per
+                cartesian combination of per-axis segments (wrap images of
+                the same coarse row accumulate — they carry different
+                faces' fluxes)."""
                 Pp = jnp.pad(
                     P_src,
                     (
@@ -419,37 +431,29 @@ class Advection:
                 P = Pp.reshape(
                     npool[2], 2, npool[1], 2, npool[0], 2
                 ).sum(axis=(1, 3, 5))
-                # fold wrapped edge rows into their modulo image, per axis
-                for d in range(3):
-                    ax = 2 - d
-                    r = routes[d]
-                    main = jax.lax.slice_in_dim(P, r["i0"], r["i1"], axis=ax)
-                    for i, gtar in r["wrap_rows"]:
-                        j = gtar - r["g0"]               # row inside main
-                        if 0 <= j < r["i1"] - r["i0"]:
-                            row = jax.lax.slice_in_dim(P, i, i + 1, axis=ax)
-                            sl = [slice(None)] * 3
-                            sl[ax] = slice(j, j + 1)
-                            main = main.at[tuple(sl)].add(row)
-                    P = main
-                # one slice-add into the coarse ring grid (interior offset +1)
-                t0 = [routes[d]["g0"] - int(lo_c[d]) for d in range(3)]
-                c0 = [_clip(t0[d], 0, dims_c[d]) for d in range(3)]
-                c1 = [
-                    _clip(t0[d] + P.shape[2 - d], 0, dims_c[d])
-                    for d in range(3)
-                ]
-                if any(c1[d] <= c0[d] for d in range(3)):
-                    return delta_c_pad
-                Ps = P[
-                    c0[2] - t0[2]:c1[2] - t0[2],
-                    c0[1] - t0[1]:c1[1] - t0[1],
-                    c0[0] - t0[0]:c1[0] - t0[0],
-                ]
-                return delta_c_pad.at[
-                    1 + c0[2]:1 + c1[2], 1 + c0[1]:1 + c1[1],
-                    1 + c0[0]:1 + c1[0],
-                ].add(Ps)
+                for z0, z1, gz in segments[2]:
+                    for y0, y1, gy in segments[1]:
+                        for x0, x1, gx in segments[0]:
+                            t0 = [gx - int(lo_c[0]), gy - int(lo_c[1]),
+                                  gz - int(lo_c[2])]
+                            ext = [x1 - x0, y1 - y0, z1 - z0]
+                            c0 = [_clip(t0[a], 0, dims_c[a]) for a in range(3)]
+                            c1 = [
+                                _clip(t0[a] + ext[a], 0, dims_c[a])
+                                for a in range(3)
+                            ]
+                            if any(c1[a] <= c0[a] for a in range(3)):
+                                continue
+                            Ps = P[
+                                z0 + c0[2] - t0[2]:z0 + c1[2] - t0[2],
+                                y0 + c0[1] - t0[1]:y0 + c1[1] - t0[1],
+                                x0 + c0[0] - t0[0]:x0 + c1[0] - t0[0],
+                            ]
+                            delta_c_pad = delta_c_pad.at[
+                                1 + c0[2]:1 + c1[2], 1 + c0[1]:1 + c1[1],
+                                1 + c0[0]:1 + c1[0],
+                            ].add(Ps)
+                return delta_c_pad
 
             pconsts[fi] = dict(ci=ci, upsample=upsample, pool_route=pool_route)
 

@@ -99,8 +99,9 @@ def test_boxed_matches_flat_wrap_corner():
 
 
 def test_boxed_matches_flat_wrap_high_edge():
-    # refined region at the HIGH domain corner: the last pooled plane wraps
-    # to coarse coordinate 0 (the s == +1 wrap branch of pool_add)
+    # refined region at the HIGH domain corner: the last pooled row wraps
+    # to coarse coordinate 0, outside pool_route's main in-domain block,
+    # so it must be routed by its own single-row segment
     _compare(_grid(n=8, maxref=1, refine_center=(1.0, 1.0, 1.0), radii=(0.3,)),
              steps=12)
 
