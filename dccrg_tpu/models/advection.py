@@ -218,345 +218,14 @@ class Advection:
     # ------------------------------------------------------ boxed AMR path
 
     def _build_boxed_run(self, layout):
-        """Multi-step run over the boxed per-level layout
-        (``parallel/boxed.py``).  One unified dense pass per level:
+        """Multi-step run over the boxed per-level AMR layout — one unified
+        dense pass per level per step, z-slab sharded over the device mesh
+        with circular ppermute plane rings.  See
+        ``models/boxed_advection.py`` for the full scheme and the
+        multi-device correctness argument."""
+        from .boxed_advection import build_boxed_run
 
-        Each level's box is extended by a one-voxel ring ([bz+2, by+2,
-        bx+2]); every voxel carries a value ``val = use_rho ? rho :
-        upsampled-coarse`` where ``use_rho`` marks voxels holding a leaf of
-        this level (wrap copies included on periodic fully-covered axes).
-        A single per-axis upwind flux pass over ``val`` with combined
-        static weights then prices same-level AND coarse|fine faces
-        together: at a cross face one operand is automatically the
-        upsampled coarse value, and the 2:1 face velocity
-        ``(2*v_fine + v_coarse)/3`` (the reference interpolation
-        ``(cl*v_nbr + nl*v_cell)/(cl+nl)`` with ``nl == 2*cl``) is baked
-        into the weight.  Fine cells read their own deltas directly; the
-        deltas accumulated on NON-leaf voxels are exactly the coarse
-        receivers' mass fluxes, recovered by one parity-aligned 2x
-        sum-pool per pair (octree invariant asserted in ``CrossPair``)
-        with modulo folding for periodic wrap — no gathers or scatters in
-        the loop.
-
-        Velocities are loop-invariant inside a run, so all weights and
-        upwind selections are computed once at run start; the loop body
-        touches only density.  Produces the same update as the general
-        gather path (solve.hpp:129-260 semantics) with a different — but
-        fixed — floating-point association order."""
-        dtype = self.dtype
-        mapping = self.grid.mapping
-        topology = self.grid.topology
-        periodic = [topology.is_periodic(d) for d in range(3)]
-        boxes = sorted(layout.boxes.values(), key=lambda b: b.level)
-        lvl_index = {b.level: i for i, b in enumerate(boxes)}
-        pair_of_fine = {pr.fine_level: pr for pr in layout.pairs}
-
-        def _clip(v, lo, hi):
-            return int(min(max(v, lo), hi))
-
-        consts = []
-        for b in boxes:
-            lvl = b.level
-            lo = b.lo.astype(np.int64)                  # (3,) x,y,z level units
-            bz, by, bx = b.shape
-            dims = np.array([bx, by, bz])
-            n_dom = np.array(mapping.length) << lvl     # domain extent, x,y,z
-            covers = [
-                bool(periodic[d] and lo[d] == 0 and dims[d] == n_dom[d])
-                for d in range(3)
-            ]
-            # ring-padded static masks; np.pad per axis: wrap on covered
-            # periodic axes (ring = copies of the opposite edge), else zero
-            def ring_pad(arr, fill=False):
-                out = arr
-                for a in range(3):
-                    pw = [(0, 0)] * out.ndim
-                    pw[a] = (1, 1)
-                    if covers[2 - a]:
-                        out = np.pad(out, pw, mode="wrap")
-                    else:
-                        out = np.pad(out, pw, mode="constant",
-                                     constant_values=fill)
-                return out
-
-            use_rho = ring_pad(b.leaf_mask)
-            m_same = np.stack([ring_pad(b.face_valid[d]) for d in range(3)])
-            # cross-face masks on the ring-padded grid: low side fine
-            # (mask_plus at the fine voxel) or high side fine (mask_minus,
-            # registered at the coarse voxel p - e_d, which may be ring)
-            m_cross_lowf = np.zeros((3,) + use_rho.shape, dtype=bool)
-            m_cross_highf = np.zeros((3,) + use_rho.shape, dtype=bool)
-            pr = pair_of_fine.get(lvl)
-            if pr is not None:
-                inner = (slice(1, 1 + bz), slice(1, 1 + by), slice(1, 1 + bx))
-                for d in range(3):
-                    m_cross_lowf[d][inner] = pr.mask_plus[d]
-                    # shift mask_minus to the low-side voxel along axis d
-                    ax = 2 - d
-                    sl = [slice(1, 1 + bz), slice(1, 1 + by), slice(1, 1 + bx)]
-                    sl[ax] = slice(0, sl[ax].stop - 1)
-                    m_cross_highf[d][tuple(sl)] = pr.mask_minus[d]
-            # no face may pair the last ring voxel with the (rolled) first
-            for d in range(3):
-                ax = 2 - d
-                sl = [slice(None)] * 3
-                sl[ax] = slice(-1, None)
-                m_same[d][tuple(sl)] = False
-                m_cross_lowf[d][tuple(sl)] = False
-                m_cross_highf[d][tuple(sl)] = False
-
-            area = np.array(
-                [
-                    b.length[1] * b.length[2],
-                    b.length[0] * b.length[2],
-                    b.length[0] * b.length[1],
-                ]
-            )
-            consts.append(
-                dict(
-                    level=lvl,
-                    lo=lo,
-                    shape=b.shape,
-                    covers=covers,
-                    n_dom=n_dom,
-                    rows=jnp.asarray(b.rows, jnp.int32),
-                    leaf=jnp.asarray(b.leaf_mask),
-                    use_rho=jnp.asarray(use_rho),
-                    m_same=jnp.asarray(m_same),
-                    m_cross_lowf=jnp.asarray(m_cross_lowf),
-                    m_cross_highf=jnp.asarray(m_cross_highf),
-                    any_face=jnp.asarray(m_same | m_cross_lowf | m_cross_highf),
-                    pool_mask=jnp.asarray(~use_rho),
-                    area=area.astype(dtype),
-                    inv_vol=dtype(1.0 / float(np.prod(b.length))),
-                    leaf_flat=jnp.asarray(b.leaf_flat, jnp.int32),
-                    leaf_rows=jnp.asarray(b.leaf_rows, jnp.int32),
-                )
-            )
-
-        # ---- per-pair static plumbing: the coarse window feeding the fine
-        # ring grid, and the pooled-delta routing back into the coarse box
-        pconsts = {}
-        for pr in layout.pairs:
-            fb = layout.boxes[pr.fine_level]
-            cb = layout.boxes[pr.coarse_level]
-            fi, ci = lvl_index[pr.fine_level], lvl_index[pr.coarse_level]
-            lo_f = fb.lo.astype(np.int64)
-            lo_c = cb.lo.astype(np.int64)
-            bz, by, bx = fb.shape
-            dims_f = np.array([bx, by, bz])
-            cz, cy, cx = cb.shape
-            dims_c = np.array([cx, cy, cz])
-            n_c = np.array(mapping.length) << pr.coarse_level
-            # coarse window covering the ring grid: coords [clo, chi),
-            # wrapped modulo the domain on periodic axes; positions with no
-            # real neighbor carry garbage that the face masks zero out
-            clo = (lo_f - 1) >> 1
-            chi = ((lo_f + dims_f) >> 1) + 1
-            win_idx = []
-            for d in range(3):
-                coords = np.arange(clo[d], chi[d])
-                if periodic[d]:
-                    coords = coords % n_c[d]
-                win_idx.append(
-                    np.clip(coords - lo_c[d], 0, dims_c[d] - 1).astype(np.int32)
-                )
-            off = lo_f - 1 - 2 * clo                    # 0/1 per axis
-
-            def upsample(carr, win_idx=win_idx, off=off, shape=fb.shape):
-                win = carr
-                for a in range(3):
-                    win = jnp.take(win, win_idx[2 - a], axis=a)
-                up = win
-                for a in range(3):
-                    up = jnp.repeat(up, 2, axis=a)
-                bz, by, bx = shape
-                return up[
-                    off[2]:off[2] + bz + 2,
-                    off[1]:off[1] + by + 2,
-                    off[0]:off[0] + bx + 2,
-                ]
-
-            # pooling of the ring grid: pad to global-even alignment of the
-            # ring origin lo_f - 1, 2x sum-pool, then route pooled planes to
-            # coarse coords (modulo folding on periodic axes)
-            go = lo_f - 1
-            plo_pad = [int(go[d] & 1) for d in range(3)]
-            psz = [int(dims_f[d]) + 2 + plo_pad[d] for d in range(3)]
-            phi_pad = [psz[d] % 2 for d in range(3)]
-            npool = [(psz[d] + phi_pad[d]) // 2 for d in range(3)]
-            cplo = go >> 1                               # pooled coord origin
-
-            # per-axis routing: contiguous segments of pooled rows that map
-            # to contiguous coarse coordinates under modulo wrap — the main
-            # in-domain block plus one single-row segment per wrapped edge
-            # row.  A wrap target may land *inside* or *outside* the main
-            # block (a box touching but not covering a periodic axis wraps
-            # to the far side of the domain); either way its segment gets
-            # its own slice-add, so no pooled flux is ever dropped.
-            segments = []                                # per axis: (i0, i1, g)
-            for d in range(3):
-                g = cplo[d] + np.arange(npool[d])
-                if periodic[d]:
-                    gm = g % n_c[d]
-                else:
-                    gm = g
-                inside = (gm >= 0) & (gm < n_c[d])
-                main = (g >= 0) & (g < n_c[d])
-                segs = []
-                if main.any():
-                    i0 = int(np.argmax(main))
-                    i1 = int(len(g) - np.argmax(main[::-1]))
-                    segs.append((i0, i1, int(g[i0])))
-                for i in np.flatnonzero(inside & ~main):
-                    segs.append((int(i), int(i) + 1, int(gm[i])))
-                segments.append(segs)
-
-            def pool_route(delta_c_pad, P_src, plo_pad=plo_pad,
-                           phi_pad=phi_pad, npool=npool, segments=segments,
-                           lo_c=lo_c, dims_c=dims_c):
-                """2x sum-pool the masked ring-grid deltas and add them into
-                the coarse level's (ring-padded) delta, one slice-add per
-                cartesian combination of per-axis segments (wrap images of
-                the same coarse row accumulate — they carry different
-                faces' fluxes)."""
-                Pp = jnp.pad(
-                    P_src,
-                    (
-                        (plo_pad[2], phi_pad[2]),
-                        (plo_pad[1], phi_pad[1]),
-                        (plo_pad[0], phi_pad[0]),
-                    ),
-                )
-                P = Pp.reshape(
-                    npool[2], 2, npool[1], 2, npool[0], 2
-                ).sum(axis=(1, 3, 5))
-                for z0, z1, gz in segments[2]:
-                    for y0, y1, gy in segments[1]:
-                        for x0, x1, gx in segments[0]:
-                            t0 = [gx - int(lo_c[0]), gy - int(lo_c[1]),
-                                  gz - int(lo_c[2])]
-                            ext = [x1 - x0, y1 - y0, z1 - z0]
-                            c0 = [_clip(t0[a], 0, dims_c[a]) for a in range(3)]
-                            c1 = [
-                                _clip(t0[a] + ext[a], 0, dims_c[a])
-                                for a in range(3)
-                            ]
-                            if any(c1[a] <= c0[a] for a in range(3)):
-                                continue
-                            Ps = P[
-                                z0 + c0[2] - t0[2]:z0 + c1[2] - t0[2],
-                                y0 + c0[1] - t0[1]:y0 + c1[1] - t0[1],
-                                x0 + c0[0] - t0[0]:x0 + c1[0] - t0[0],
-                            ]
-                            delta_c_pad = delta_c_pad.at[
-                                1 + c0[2]:1 + c1[2], 1 + c0[1]:1 + c1[1],
-                                1 + c0[0]:1 + c1[0],
-                            ].add(Ps)
-                return delta_c_pad
-
-            pconsts[fi] = dict(ci=ci, upsample=upsample, pool_route=pool_route)
-
-        @jax.jit
-        def run(state, steps, dt):
-            dt = jnp.asarray(dt, dtype)
-            rho_flat = state["density"][0]
-            v_flat = (state["vx"][0], state["vy"][0], state["vz"][0])
-
-            def to_box(flat, c):
-                vals = flat[c["rows"]].reshape(c["shape"])
-                return jnp.where(c["leaf"], vals, 0)
-
-            def ring(arr, c):
-                """Ring-pad a box array: wrap on covered periodic axes."""
-                out = arr
-                for a in range(3):
-                    pw = [(0, 0)] * 3
-                    pw[a] = (1, 1)
-                    mode = "wrap" if c["covers"][2 - a] else "constant"
-                    out = jnp.pad(out, pw, mode=mode)
-                return out
-
-            rhos = tuple(to_box(rho_flat, c) for c in consts)
-            vels = [tuple(to_box(v, c) for v in v_flat) for c in consts]
-
-            # static per-level face weights and upwind selections
-            stat = []
-            for li, c in enumerate(consts):
-                p = pconsts.get(li)
-                ups = (
-                    [p["upsample"](vels[p["ci"]][d]) for d in range(3)]
-                    if p is not None
-                    else [jnp.zeros(c["use_rho"].shape, dtype)] * 3
-                )
-                per_axis = []
-                for d in range(3):
-                    ax = 2 - d
-                    v_val = jnp.where(
-                        c["use_rho"], ring(vels[li][d], c), ups[d]
-                    )
-                    vl, vh = v_val, jnp.roll(v_val, -1, ax)
-                    v_face = jnp.where(
-                        c["m_same"][d], 0.5 * (vl + vh),
-                        jnp.where(
-                            c["m_cross_lowf"][d], (2 * vl + vh) / 3,
-                            (vl + 2 * vh) / 3,
-                        ),
-                    )
-                    w = jnp.where(
-                        c["any_face"][d], dt * v_face * c["area"][d], 0
-                    )
-                    per_axis.append((v_face >= 0, w))
-                stat.append(per_axis)
-
-            def body(i, rhos):
-                deltas = []
-                for li, c in enumerate(consts):
-                    p = pconsts.get(li)
-                    val = ring(rhos[li], c)
-                    if p is not None:
-                        val = jnp.where(
-                            c["use_rho"], val, p["upsample"](rhos[p["ci"]])
-                        )
-                    delta = jnp.zeros_like(val)
-                    for d in range(3):
-                        ax = 2 - d
-                        upsel, w = stat[li][d]
-                        F = jnp.where(upsel, val, jnp.roll(val, -1, ax)) * w
-                        delta = delta + (jnp.roll(F, 1, ax) - F)
-                    deltas.append(delta)
-                # route non-leaf voxel deltas (= coarse receivers' fluxes)
-                # fine-to-coarse, finest level first
-                for li in range(len(consts) - 1, -1, -1):
-                    p = pconsts.get(li)
-                    if p is None:
-                        continue
-                    deltas[p["ci"]] = p["pool_route"](
-                        deltas[p["ci"]], deltas[li] * consts[li]["pool_mask"]
-                    )
-                new = []
-                for li, c in enumerate(consts):
-                    d_in = deltas[li][1:-1, 1:-1, 1:-1]
-                    new.append(
-                        jnp.where(
-                            c["leaf"], rhos[li] + d_in * c["inv_vol"], 0
-                        )
-                    )
-                return tuple(new)
-
-            rhos = jax.lax.fori_loop(0, steps, body, rhos)
-            out = rho_flat
-            for li, c in enumerate(consts):
-                out = out.at[c["leaf_rows"]].set(
-                    rhos[li].reshape(-1)[c["leaf_flat"]]
-                )
-            return {
-                **state,
-                "density": out[None],
-                "flux": jnp.zeros_like(state["flux"]),
-            }
-
-        return run
+        return build_boxed_run(self, layout)
 
     # ------------------------------------------------------ dense fast path
 

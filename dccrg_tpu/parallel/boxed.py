@@ -7,30 +7,39 @@ contrast, stream at HBM bandwidth.  This module therefore re-derives the
 reference's per-cell neighbor iteration (``dccrg.hpp:4339-4861``) as a
 Berger-Oliger-style decomposition:
 
-* every refinement level's leaves are scattered into a dense box (the
-  bounding box of that level's cells, ``[z, y, x]`` order) — same-level face
+* every refinement level's leaves are scattered into a dense box (``[z, y,
+  x]`` array order; the tight leaf bounding box on one device, or the full
+  domain in z and the bounding box in x/y multi-device) — same-level face
   coupling, asymptotically all of the work, becomes masked shifted slices;
 * cross-level faces (an O(surface) set, |level difference| == 1 by the 2:1
   invariant) are ALSO dense: per adjacent level pair, boolean fine-side
   face masks (``CrossPair``) drive a kernel that upsamples the coarse box
   2x over the fine box's footprint, computes per-fine-face mass fluxes as
   masked dense arrays, and routes their exact negations to the coarse
-  receivers by a parity-aligned 2x sum-pool plus one-cell shift — no
-  gathers or scatters anywhere.
+  receivers by a parity-aligned 2x sum-pool — no gathers or scatters.
+
+Multi-device: each level's box is z-slab partitioned over the device mesh
+(``bz == nz0 * 2^level`` divisible by D, one equal slab per device — the
+same decomposition as ``parallel/dense.py`` for uniform grids, which this
+layout generalizes).  The z ring is a circular ``lax.ppermute`` plane
+exchange per level per step; periodic z wrap IS the circular device ring.
+The grid qualifies when ownership is the z-slab partition by level-0 row
+(the initial BLOCK striping of an unrefined grid, preserved by refinement
+since children inherit the parent's owner; restorable after other
+balancing with the ``ZSLAB`` method).
 
 Correctness notes:
 
 * ``face_valid`` masks are scattered directly from the same-level face
   entries of the neighbor lists, so the dense kernel covers *exactly* the
-  pairs the general gather path would — including periodic wraps, which can
-  only occur when the box spans the full axis (both endpoints of the axis
-  hold leaves of that level), making ``jnp.roll`` exact.
+  pairs the general gather path would; z-wrap faces register at their true
+  (modulo) interior coordinate, x/y wraps can only occur when the box
+  spans the full axis (both endpoints hold leaves of that level), making
+  the wrap ring pad exact.
 * the builder returns ``None`` whenever the layout does not apply
-  (multi-device epoch, non-uniform per-level geometry, missing face offsets
-  in the neighborhood, or pathological bounding-box blowup) — callers fall
-  back to the flat gather path.
-
-Single-device v1: multi-device grids keep the general ``all_to_all`` path.
+  (non-slab partition, D not dividing nz, non-uniform per-level geometry,
+  missing face offsets in the neighborhood, or pathological bounding-box
+  blowup) — callers fall back to the flat gather path.
 """
 from __future__ import annotations
 
@@ -48,17 +57,20 @@ _FACE_OFFSETS = np.array(
 
 @dataclass
 class LevelBox:
-    """One refinement level's dense box ([z, y, x] array order)."""
+    """One refinement level's dense box ([z, y, x] array order).
+
+    Multi-device (``n_devices > 1``): ``lo[2] == 0`` and ``shape[0] ==
+    nz0 << level`` — the z extent is the full domain so the z-slab
+    partition is uniform across devices.  Single device: the tight leaf
+    bounding box on every axis."""
 
     level: int
     lo: np.ndarray          # (3,) int64 box min corner, level-l cell units [x, y, z]
     shape: tuple            # (bz, by, bx)
-    rows: np.ndarray        # (bz*by*bx,) int32 epoch row per position (scratch pad)
+    rows: np.ndarray        # (bz*by*bx,) int32 owner-local epoch row per position
     leaf_mask: np.ndarray   # (bz, by, bx) bool
     face_valid: np.ndarray  # (3, bz, by, bx) bool: +x/+y/+z face handled densely
     length: np.ndarray      # (3,) float64 physical cell length [x, y, z]
-    leaf_flat: np.ndarray   # (n_leaf,) int64 flat box positions of leaves
-    leaf_rows: np.ndarray   # (n_leaf,) int32 epoch rows of leaves
 
 
 @dataclass
@@ -88,6 +100,7 @@ class BoxedLayout:
     boxes: dict             # level -> LevelBox
     pairs: list             # [CrossPair]
     n_cells: int            # total leaves covered
+    n_devices: int          # z-slab count (1 = single device)
 
 
 def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
@@ -97,8 +110,7 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
     from ..geometry.stretched import StretchedCartesianGeometry
 
     epoch = grid.epoch
-    if epoch.n_devices != 1:
-        return None
+    D = epoch.n_devices
     if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
         grid.geometry, StretchedCartesianGeometry
     ):
@@ -117,9 +129,18 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
     N = len(leaves)
     if N == 0:
         return None
+    nz0 = int(mapping.length[2])
+    if nz0 % D != 0:
+        return None
     L = mapping.max_refinement_level
     lvl_all = mapping.get_refinement_level(leaves.cells).astype(np.int64)
     idx_all = mapping.get_indices(leaves.cells).astype(np.int64)  # (N, 3) x,y,z
+    if D > 1:
+        # ownership must be the z-slab partition by level-0 row
+        z0 = idx_all[:, 2] >> L
+        expected_owner = (z0 // (nz0 // D)).astype(leaves.owner.dtype)
+        if not np.array_equal(leaves.owner, expected_owner):
+            return None
     level0_len = np.asarray(grid.geometry.get_level_0_cell_length(), dtype=np.float64)
 
     scratch = epoch.R - 1
@@ -132,9 +153,17 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
         p = idx_all[sel] >> shift                       # (n, 3) x,y,z level units
         lo = p.min(axis=0)
         hi = p.max(axis=0) + 1
+        if D > 1:
+            # full-domain z extent so the z-slab partition is uniform
+            # across devices; one device keeps the tight bounding box
+            lo[2] = 0
+            hi[2] = nz0 << int(lvl)
         dims = hi - lo
         total_box += int(dims.prod())
-        if total_box > max(int(max_expand * N), 1 << 22):
+        # multi-device layouts get 2x headroom: the full-domain z extent
+        # inflates boxes beyond the tight bound the cap was tuned for
+        allow = max_expand * N if D == 1 else 2 * max_expand * N
+        if total_box > max(int(allow), 1 << 22):
             return None
         bx, by, bz = int(dims[0]), int(dims[1]), int(dims[2])
         q = p - lo
@@ -151,8 +180,6 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
             leaf_mask=leaf_mask.reshape(bz, by, bx),
             face_valid=np.zeros((3, bz, by, bx), dtype=bool),
             length=level0_len / (1 << int(lvl)),
-            leaf_flat=flat.astype(np.int64),
-            leaf_rows=epoch.row_of[sel].astype(np.int32),
         )
 
     # ---- face classification over the flat neighbor lists (the E-flat
@@ -221,4 +248,4 @@ def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
                 )
             )
 
-    return BoxedLayout(boxes=boxes, pairs=pairs, n_cells=N)
+    return BoxedLayout(boxes=boxes, pairs=pairs, n_cells=N, n_devices=D)

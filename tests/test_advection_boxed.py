@@ -142,10 +142,72 @@ def test_boxed_run_equals_repeated_boxed_runs():
     )
 
 
-def test_boxed_disabled_multi_device():
-    g = _grid(n=8, maxref=1, n_devices=2)
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_boxed_multi_device_matches_flat(n_devices):
+    # the z-slab boxed layout engages on any device count dividing nz;
+    # every device prices the faces registered in its padded slab (cut and
+    # periodic-seam faces included) and the result matches the general
+    # gather path
+    adv = _compare(_grid(n=8, maxref=1, n_devices=n_devices), steps=8)
+    assert adv.boxed.n_devices == n_devices
+
+
+def test_boxed_multi_device_wrap_corner():
+    # refined region spanning the periodic corner across device cuts
+    _compare(
+        _grid(n=8, maxref=1, n_devices=4, refine_center=(0.0, 0.0, 0.0),
+              radii=(0.3,)),
+        steps=12,
+    )
+
+
+def test_boxed_multi_device_two_levels():
+    adv = _compare(_grid(n=8, maxref=2, n_devices=2, radii=(0.3, 0.15)),
+                   steps=8)
+    assert sorted(adv.boxed.boxes) == [0, 1, 2]
+
+
+def test_boxed_multi_device_matches_single_device():
+    # same grid, 1 vs 4 devices: the boxed update is association-order
+    # identical, so results agree to the last ulp
+    outs = []
+    for nd in (1, 4):
+        g = _grid(n=8, maxref=1, n_devices=nd)
+        adv = Advection(g, dtype=np.float64, allow_dense=False)
+        assert adv.boxed is not None
+        state = adv.initialize_state()
+        out = adv._boxed_run(state, 10, np.float64(0.02))
+        ids = np.sort(g.get_cells())
+        outs.append(np.asarray(g.get_cell_data(out, "density", ids)))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-14, atol=1e-16)
+
+
+def test_boxed_disabled_non_slab_partition():
+    # a non-z-slab ownership (RCB repartition) falls back to the gather path
+    n = 8
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_load_balancing_method("RCB")
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n, 1.0 / n, 1.0 / n),
+        )
+        .initialize(mesh=make_mesh(n_devices=2))
+    )
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    r = np.linalg.norm(c - np.array([0.3, 0.5, 0.5]), axis=1)
+    for cid in ids[r < 0.25]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    g.balance_load()
     adv = Advection(g, dtype=np.float64, allow_dense=False)
-    assert adv.boxed is None  # falls back to the general path
+    assert adv.boxed is None
 
 
 def test_boxed_disabled_stretched_geometry():
