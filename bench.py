@@ -10,8 +10,20 @@ reference's AoS 9-double cell layout and neighbor indirection, g++ -O3
 -fopenmp over all host cores (documented in BASELINE.md's protocol as the
 locally-measured stand-in).
 
+Four measurements (BASELINE.md "Measurement protocol" steps 2-3):
+
+* headline: uniform 128x128x64 grid, whole-block fused Pallas kernel;
+* refined: two-level AMR grid (the reference's flagship configuration,
+  tests/game_of_life/refined_scalability3d.cpp analogue) on the boxed
+  per-level fast path;
+* large: a >VMEM 512x512x128 grid on the per-step path (no whole-block
+  fusion possible — measures the streaming regime);
+* multidev: an 8-device virtual CPU mesh run (subprocess; the image has
+  one physical TPU chip) reporting achieved halo bytes/s through the
+  ppermute plane exchange and a device-count-invariant checksum.
+
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": ...}
 """
 import json
 import os
@@ -26,50 +38,64 @@ ROOT = pathlib.Path(__file__).resolve().parent
 # is f64-on-CPU; f32 is the TPU-native precision choice and is recorded)
 NX, NY, NZ = 128, 128, 64
 STEPS = 5000
+REFINED_N = 48          # 48^3 level-0, ball refined -> ~198k cells, 2 levels
+REFINED_STEPS = 2000
+LARGE = (512, 512, 128)  # f32 density alone is 128 MiB: cannot fit VMEM
+LARGE_STEPS = 200
+
+
+def _best_of(f, n=3):
+    import jax
+
+    secs = float("inf")
+    out = None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = f()
+        jax.block_until_ready(out)
+        secs = min(secs, time.perf_counter() - t0)
+    return secs, out
+
+
+def _uniform_grid(shape, n_devices=None):
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+
+    nx, ny, nz = shape
+    return (
+        Grid()
+        .set_initial_length((nx, ny, nz))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / nx, 1.0 / ny, 1.0 / nz),
+        )
+        .initialize(mesh=make_mesh(n_devices=n_devices))
+    )
 
 
 def measure_tpu() -> dict:
     import jax
     import numpy as np
 
-    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
     from dccrg_tpu.models import Advection
 
-    mesh = make_mesh()
-    n_dev = mesh.devices.size
-    g = (
-        Grid()
-        .set_initial_length((NX, NY, NZ))
-        .set_neighborhood_length(0)
-        .set_periodic(True, True, True)
-        .set_geometry(
-            CartesianGeometry,
-            start=(0.0, 0.0, 0.0),
-            level_0_cell_length=(1.0 / NX, 1.0 / NY, 1.0 / NZ),
-        )
-        .initialize(mesh=mesh)
-    )
+    g = _uniform_grid((NX, NY, NZ))
+    n_dev = g.mesh.devices.size
     adv = Advection(g, dtype=np.float32)
     state = adv.initialize_state()
-    dt = np.float32(0.4 * adv.max_time_step(state))
+    dt = np.float32(0.4 * adv.max_time_step(state))  # D2H: sync is armed
 
-    # warmup + compile (device-side loop: one dispatch for the whole run)
-    jax.block_until_ready(adv.run(state, 2, dt))
-
+    jax.block_until_ready(adv.run(state, 2, dt))     # warmup + compile
     # best of 3: the device is reached through a shared tunnel whose
     # slowdowns are one-sided noise, so min time estimates capability
-    secs = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = adv.run(state, STEPS, dt)
-        jax.block_until_ready(out)
-        secs = min(secs, time.perf_counter() - t0)
-    state = out
+    secs, out = _best_of(lambda: adv.run(state, STEPS, dt))
 
     n_cells = NX * NY * NZ
     updates_per_s = n_cells * STEPS / secs
     halo = g.halo(None)
-    halo_bytes = halo.bytes_moved({"density": state["density"]}) * STEPS
+    halo_bytes = halo.bytes_moved({"density": out["density"]}) * STEPS
     return {
         "updates_per_s": updates_per_s,
         "updates_per_s_per_chip": updates_per_s / n_dev,
@@ -78,6 +104,147 @@ def measure_tpu() -> dict:
         "halo_GBps": halo_bytes / secs / 1e9,
         "secs": secs,
     }
+
+
+def measure_refined() -> dict:
+    """Two-level AMR grid on the boxed per-level fast path — the
+    reference's actual use case (cell-by-cell adaptive refinement)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu.models import Advection
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+
+    n = REFINED_N
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n, 1.0 / n, 1.0 / n),
+        )
+        .initialize(mesh=make_mesh())
+    )
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    r = np.linalg.norm(c - np.array([0.3, 0.5, 0.5]), axis=1)
+    for cid in ids[r < 0.3]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    n_cells = len(g.get_cells())
+
+    adv = Advection(g, dtype=np.float32, allow_dense=False)
+    assert adv.boxed is not None, "boxed fast path must engage"
+    state = adv.initialize_state()
+    dt = np.float32(0.4 * adv.max_time_step(state))
+    jax.block_until_ready(adv.run(state, 2, dt))
+    secs, _ = _best_of(lambda: adv.run(state, REFINED_STEPS, dt))
+    return {
+        "n_cells": n_cells,
+        "levels": sorted(adv.boxed.boxes),
+        "updates_per_s": n_cells * REFINED_STEPS / secs,
+        "secs": secs,
+    }
+
+
+def measure_large() -> dict:
+    """>VMEM grid: the whole-block fused kernel cannot engage; measures
+    the per-step streaming path (HBM-bandwidth regime)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu.models import Advection
+    from dccrg_tpu.ops.dense_advection import fused_run_fits
+
+    nx, ny, nz = LARGE
+    g = _uniform_grid(LARGE)
+    adv = Advection(g, dtype=np.float32)
+    assert adv.dense is not None
+    assert not fused_run_fits(nz // g.mesh.devices.size, ny, nx), (
+        "large grid unexpectedly fits VMEM; raise LARGE"
+    )
+    state = adv.initialize_state()
+    dt = np.float32(0.4 * adv.max_time_step(state))
+    jax.block_until_ready(adv.run(state, 2, dt))
+    secs, _ = _best_of(lambda: adv.run(state, LARGE_STEPS, dt))
+    n_cells = nx * ny * nz
+    return {
+        "grid": list(LARGE),
+        "updates_per_s": n_cells * LARGE_STEPS / secs,
+        "secs": secs,
+    }
+
+
+def measure_multidev_cpu() -> dict | None:
+    """8-device virtual CPU mesh (subprocess): achieved halo bytes/s over
+    the ppermute plane exchange + a device-count-invariant checksum
+    (compared against a 1-device run of the same program)."""
+    code = r"""
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+import sys
+sys.path.insert(0, %r)
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models import Advection
+
+def run(n_devices):
+    n = 64
+    g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
+         .set_periodic(True, True, True)
+         .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                       level_0_cell_length=(1.0/n,)*3)
+         .initialize(mesh=make_mesh(n_devices=n_devices)))
+    adv = Advection(g, dtype=np.float32)
+    state = adv.initialize_state()
+    dt = np.float32(0.4 * adv.max_time_step(state))
+    steps = 50
+    jax.block_until_ready(adv.run(state, 2, dt))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = adv.run(state, steps, dt)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    halo = g.halo(None)
+    halo_bytes = halo.bytes_moved({"density": out["density"]}) * steps
+    checksum = float(np.asarray(out["density"], dtype=np.float64).sum())
+    return dict(n_devices=n_devices, steps=steps, secs=best,
+                halo_GBps=halo_bytes / best / 1e9, checksum=checksum)
+
+r8 = run(8)
+r1 = run(1)
+r8["checksum_rel_err_vs_1dev"] = abs(r8["checksum"] - r1["checksum"]) / abs(r1["checksum"])
+print("BENCH_JSON:" + json.dumps(r8))
+""" % str(ROOT)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=1200,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("BENCH_JSON:"):
+                return json.loads(line[len("BENCH_JSON:"):])
+        print(f"multidev bench produced no result: {r.stderr[-2000:]}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - report, never kill the bench
+        print(f"multidev bench failed: {e}", file=sys.stderr)
+    return None
 
 
 def measure_cpu_baseline() -> float:
@@ -109,12 +276,49 @@ def measure_cpu_baseline() -> float:
 
 def main():
     tpu = measure_tpu()
+    extras = {}
+    for name, fn in (("refined", measure_refined), ("large", measure_large),
+                     ("multidev_cpu", measure_multidev_cpu)):
+        try:
+            extras[name] = fn()
+        except Exception as e:  # noqa: BLE001 - partial results still count
+            print(f"{name} bench failed: {e}", file=sys.stderr)
+            extras[name] = None
     try:
         cpu = measure_cpu_baseline()
     except Exception as e:  # baseline build failure must not kill the bench
         print(f"cpu baseline failed: {e}", file=sys.stderr)
         cpu = None
     vs = tpu["updates_per_s_per_chip"] / cpu if cpu else -1.0
+    detail = {
+        "grid": [NX, NY, NZ],
+        "steps": STEPS,
+        "platform": tpu["platform"],
+        "n_devices": tpu["n_devices"],
+        "halo_GBps": round(tpu["halo_GBps"], 3),
+        "cpu_baseline_updates_per_s": cpu,
+        "dtype": "float32",
+    }
+    if extras.get("refined"):
+        ref = extras["refined"]
+        detail["refined"] = {
+            "n_cells": ref["n_cells"],
+            "levels": ref["levels"],
+            "updates_per_s": round(ref["updates_per_s"], 1),
+            "vs_baseline": round(ref["updates_per_s"] / cpu, 3) if cpu else -1,
+        }
+    if extras.get("large"):
+        lg = extras["large"]
+        detail["large"] = {
+            "grid": lg["grid"],
+            "updates_per_s": round(lg["updates_per_s"], 1),
+            "vs_baseline": round(lg["updates_per_s"] / cpu, 3) if cpu else -1,
+        }
+    if extras.get("multidev_cpu"):
+        detail["multidev_cpu"] = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in extras["multidev_cpu"].items()
+        }
     print(
         json.dumps(
             {
@@ -122,15 +326,7 @@ def main():
                 "value": round(tpu["updates_per_s_per_chip"], 1),
                 "unit": "cell-updates/s/chip",
                 "vs_baseline": round(vs, 3),
-                "detail": {
-                    "grid": [NX, NY, NZ],
-                    "steps": STEPS,
-                    "platform": tpu["platform"],
-                    "n_devices": tpu["n_devices"],
-                    "halo_GBps": round(tpu["halo_GBps"], 3),
-                    "cpu_baseline_updates_per_s": cpu,
-                    "dtype": "float32",
-                },
+                "detail": detail,
             }
         )
     )

@@ -291,6 +291,7 @@ class Advection:
         # Optional fused Pallas kernel (TPU + f32): same update, one VMEM
         # pass per z-slab instead of XLA-materialized rolls
         from ..ops.dense_advection import (
+            flux_update_fits,
             fused_run_fits,
             make_flux_update,
             make_fused_run,
@@ -302,7 +303,9 @@ class Advection:
         # use_pallas="interpret" forces the kernels through the Pallas
         # interpreter so CI (CPU) exercises the full integration path
         interpret = use_pallas == "interpret"
-        if use_pallas and (interpret or pallas_available(dtype)):
+        if use_pallas and (
+            interpret or (pallas_available(dtype) and flux_update_fits(ny, nx))
+        ):
             pallas_update = make_flux_update(
                 nzl, ny, nx, area, 1.0 / vol, interpret=interpret
             )

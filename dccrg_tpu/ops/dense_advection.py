@@ -65,6 +65,18 @@ def _make_rolls(interpret: bool):
     )
 
 
+#: per-plane VMEM residency of the one-step kernel: ~13 plane-sized blocks
+#: double-buffered by Mosaic (measured 18 MB at 512x512 planes, above the
+#: 16 MB default scoped limit)
+_STEP_PLANE_ARRAYS = 30
+
+
+def flux_update_fits(ny: int, nx: int) -> bool:
+    """Whether the per-step kernel's plane working set fits the raised
+    scoped-VMEM budget (large x/y extents fall back to the XLA path)."""
+    return _STEP_PLANE_ARRAYS * ny * nx * 4 <= _FUSED_VMEM_BUDGET
+
+
 def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float,
                      *, interpret: bool = False):
     """Returns ``update(rho_ext, vx, vy, vz_ext, mx, my, mz_up, mz_dn, dt)
@@ -119,6 +131,14 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float,
     myspec = pl.BlockSpec((1, ny, 1), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM)
     mzspec = pl.BlockSpec((1, 1, 1), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM)
 
+    kwargs = {}
+    if not interpret:
+        # large planes exceed the 16 MB default scoped-VMEM limit (the
+        # blocks are plane-granular and Mosaic double-buffers them);
+        # flux_update_fits() gates entry against the raised budget
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_FUSED_VMEM_BUDGET
+        )
     call = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -134,6 +154,7 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float,
         ),
         out_shape=jax.ShapeDtypeStruct((nzl, ny, nx), jnp.float32),
         interpret=interpret,
+        **kwargs,
     )
 
     def update(rho_ext, vx, vy, vz_ext, mx, my, mz_up, mz_dn, dt):

@@ -74,6 +74,19 @@ def compute_partition(
         return leaves.owner.copy()
     if method == "BLOCK":
         return weighted_blocks(np.arange(len(leaves)), weights, n_parts)
+    if method == "ZSLAB":
+        # z-slab by level-0 row, equal rows per part — the ownership the
+        # boxed AMR fast path (parallel/boxed.py) requires; restores slab
+        # alignment after other balancing methods have scattered it
+        mapping = grid.mapping
+        nz0 = int(mapping.length[2])
+        if nz0 % n_parts != 0:
+            raise ValueError(
+                f"ZSLAB needs n_parts | nz ({n_parts} !| {nz0})"
+            )
+        idx = mapping.get_indices(leaves.cells)
+        z0 = idx[:, 2].astype(np.int64) >> mapping.max_refinement_level
+        return (z0 // (nz0 // n_parts)).astype(np.int32)
     if method in ("RCB", "RIB"):
         centers = grid.geometry.get_center(leaves.cells)
         return rcb_partition(centers, n_parts, weights)

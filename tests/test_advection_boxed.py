@@ -208,6 +208,11 @@ def test_boxed_disabled_non_slab_partition():
     g.balance_load()
     adv = Advection(g, dtype=np.float64, allow_dense=False)
     assert adv.boxed is None
+    # ZSLAB rebalancing restores the slab ownership and the fast path
+    g._lb_method = "ZSLAB"
+    g.balance_load()
+    adv = Advection(g, dtype=np.float64, allow_dense=False)
+    assert adv.boxed is not None and adv.boxed.n_devices == 2
 
 
 def test_boxed_disabled_stretched_geometry():
