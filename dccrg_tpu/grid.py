@@ -287,8 +287,11 @@ class Grid:
 
     def set_partitioning_option(self, name: str, value) -> "Grid":
         """Record a partitioner option (the reference forwards these as
-        Zoltan strings, ``dccrg.hpp:5537-5798``; the native partitioners
-        currently honor none but keep them introspectable)."""
+        Zoltan strings, ``dccrg.hpp:5537-5798``).  The native partitioners
+        honor ``IMBALANCE_TOL`` (max part load as a multiple of the
+        average — caps the graph methods' refinement and triggers the
+        striping methods' min-max-load repair); other options are kept
+        introspectable."""
         if not hasattr(self, "_partitioning_options"):
             self._partitioning_options = {}
         self._partitioning_options[str(name)] = value
@@ -455,7 +458,11 @@ class Grid:
         """Hierarchical partitioning level (reference Zoltan HIER,
         ``dccrg.hpp:5566-5798``): devices are grouped in blocks of
         ``processes_per_part`` (e.g. chips per ICI-connected slice); cells
-        are first balanced over groups, then within each group."""
+        are first balanced over groups, then within each group.  Multiple
+        calls nest: each later level subdivides the previous level's
+        groups (e.g. ``add_partitioning_level(4)`` then ``(2)`` on 8
+        devices gives a 2x2x2 hierarchy: slices of 4, pairs of 2, then
+        single devices)."""
         if not hasattr(self, "_hier_levels"):
             self._hier_levels = []
         self._hier_levels.append(int(processes_per_part))
@@ -478,11 +485,14 @@ class Grid:
                     weights[p] = w
 
         method = self._lb_method if use_zoltan else "NONE"
+        options = self.get_partitioning_options()
         hier = getattr(self, "_hier_levels", None)
         if hier and method.upper() != "NONE":
-            owner = self._hierarchical_partition(method, weights, hier)
+            owner = self._hierarchical_partition(method, weights, hier, options)
         else:
-            owner = compute_partition(method, self, self.n_devices, weights)
+            owner = compute_partition(
+                method, self, self.n_devices, weights, options
+            )
 
         # pins override the partitioner (make_new_partition,
         # dccrg.hpp:8417-8580)
@@ -503,24 +513,73 @@ class Grid:
         self._rebuild()
         return self
 
-    def _hierarchical_partition(self, method, weights, hier):
-        """Two-stage partition over a device hierarchy: groups of
-        ``hier[0]`` devices first (DCN level), then devices within each
-        group (ICI level)."""
+    def _hierarchical_partition(self, method, weights, hier, options=None):
+        """Multi-level partition over a device hierarchy (reference HIER,
+        ``dccrg.hpp:5566-5798``): split cells over groups of ``hier[0]``
+        devices (DCN level), then recurse into each group with the
+        remaining levels, ending at single devices (ICI level)."""
         from .parallel.loadbalance import compute_partition
 
-        per_group = hier[0]
-        n_groups = max(1, self.n_devices // per_group)
-        group = compute_partition(method, self, n_groups, weights)
+        adjacency = None
+        if method.upper() in ("GRAPH", "HYPERGRAPH"):
+            from .parallel.graph import grid_adjacency
+
+            adjacency = grid_adjacency(self)
+
         owner = np.zeros(len(self.leaves), dtype=np.int32)
-        for gi in range(n_groups):
-            idx = np.flatnonzero(group == gi)
-            if not len(idx):
-                continue
-            sub = _SubGridView(self, idx)
-            w = weights[idx] if weights is not None else None
-            local = compute_partition(method, sub, per_group, w)
-            owner[idx] = gi * per_group + local
+
+        def recurse(sub, idx, w, levels, first, n_devices, adj):
+            if n_devices <= 1 or len(idx) == 0:
+                owner[idx] = first
+                return
+            if not levels:
+                owner[idx] = first + compute_partition(
+                    method, sub, n_devices, w, options, adj
+                )
+                return
+            per = max(1, min(levels[0], n_devices))
+            # groups of `per` devices plus a remainder group when per does
+            # not divide the device count — no device may be left idle
+            group_sizes = [per] * (n_devices // per)
+            if n_devices % per:
+                group_sizes.append(n_devices % per)
+            if len(group_sizes) == 1:
+                recurse(sub, idx, w, levels[1:], first, n_devices, adj)
+                return
+            # partition at device granularity, then merge consecutive parts
+            # into groups proportional to each group's device count (equal
+            # n_groups-way cuts would misweight a remainder group)
+            fine = compute_partition(method, sub, n_devices, w, options, adj)
+            bounds = np.cumsum([0] + group_sizes)
+            group = np.searchsorted(bounds, fine, side="right") - 1
+            for gi, n_dev_g in enumerate(group_sizes):
+                sel = np.flatnonzero(group == gi)
+                if not len(sel):
+                    continue
+                sub_adj = None
+                if adj is not None:
+                    from .parallel.graph import restrict_adjacency
+
+                    sub_adj = restrict_adjacency(adj[0], adj[1], sel)
+                recurse(
+                    _SubGridView(sub, sel),
+                    idx[sel],
+                    w[sel] if w is not None else None,
+                    levels[1:],
+                    first + int(bounds[gi]),
+                    n_dev_g,
+                    sub_adj,
+                )
+
+        recurse(
+            self,
+            np.arange(len(self.leaves)),
+            weights,
+            list(hier),
+            0,
+            self.n_devices,
+            adjacency,
+        )
         return owner
 
     def initialize_balance_load(self, use_zoltan: bool = True):

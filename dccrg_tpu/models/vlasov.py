@@ -13,6 +13,11 @@ program where B rides the vectorized minor dimension.
 Uses the dense uniform-grid layout (parallel/dense.py); the halo moves
 whole f(v) blocks (B doubles per ghost cell), which is exactly the
 bandwidth profile the Vlasiator use case stresses.
+
+Boundaries follow ``grid.topology``: periodic dimensions wrap; open
+dimensions use vacuum inflow (f = 0 outside the domain) with free
+outflow, the standard open-boundary closure for an upwind scheme — mass
+then decreases monotonically as phase-space density leaves the box.
 """
 from __future__ import annotations
 
@@ -71,17 +76,29 @@ class Vlasov:
             flux_lo = jnp.where(vd >= 0, f_lo, f) * vd      # at i-1/2
             return f - dt * inv_dxd * (flux_hi - flux_lo)
 
+        periodic = tuple(bool(p) for p in info.periodic)
+
         def body(f, dt):
             f = f[0]                                  # [nzl, ny, nx, B]
-            # x and y wrap inside the block (grid is periodic for this
-            # model); z goes through the slab halo
-            f = split_dim(
-                f, jnp.roll(f, 1, 2), jnp.roll(f, -1, 2), v[:, 0], dtype(inv_dx[0]), dt, 2
-            )
-            f = split_dim(
-                f, jnp.roll(f, 1, 1), jnp.roll(f, -1, 1), v[:, 1], dtype(inv_dx[1]), dt, 1
-            )
+            # x and y wrap inside the block; open dimensions get vacuum
+            # inflow (zero the wrapped-in plane) per grid.topology
+            f_lo, f_hi = jnp.roll(f, 1, 2), jnp.roll(f, -1, 2)
+            if not periodic[0]:
+                f_lo = f_lo.at[:, :, 0].set(0)
+                f_hi = f_hi.at[:, :, -1].set(0)
+            f = split_dim(f, f_lo, f_hi, v[:, 0], dtype(inv_dx[0]), dt, 2)
+            f_lo, f_hi = jnp.roll(f, 1, 1), jnp.roll(f, -1, 1)
+            if not periodic[1]:
+                f_lo = f_lo.at[:, 0].set(0)
+                f_hi = f_hi.at[:, -1].set(0)
+            f = split_dim(f, f_lo, f_hi, v[:, 1], dtype(inv_dx[1]), dt, 1)
+            # z goes through the slab halo ring; for an open z boundary the
+            # ring's wrap-around planes on the first/last device are vacuum
             fe = extend(f)
+            if not periodic[2]:
+                d = jax.lax.axis_index(SHARD_AXIS)
+                fe = fe.at[0].multiply(jnp.where(d == 0, 0, 1).astype(dtype))
+                fe = fe.at[-1].multiply(jnp.where(d == D - 1, 0, 1).astype(dtype))
             f = split_dim(f, fe[:-2], fe[2:], v[:, 2], dtype(inv_dx[2]), dt, 0)
             return (f[None],)
 

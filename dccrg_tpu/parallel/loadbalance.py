@@ -11,15 +11,23 @@ are implemented natively over the replicated leaf directory:
   weight-balanced cuts (the curve sfc++ gives the reference);
 * ``MORTON`` — Z-order striping (cheaper keys, less compact parts);
 * ``BLOCK`` — id-order striping (the initial assignment);
-* ``GRAPH``/``HYPERGRAPH`` — served by the SFC partition: on a
-  neighborhood-bounded grid the SFC cut approximates the minimum edge cut
-  and keeps the implementation dependency-free;
+* ``GRAPH``/``HYPERGRAPH`` — native seed-and-refine partitioners over the
+  leaf adjacency minimizing the halo edge cut / communication volume
+  (``parallel/graph.py``), playing Zoltan's ParMETIS/PHG methods;
 * ``NONE`` — keep the current owners (the reference treats Zoltan failure
   as expected for NONE, ``dccrg.hpp:7709-7713``).
 
+Partitioning options (``set_partitioning_option``) are honored where they
+are meaningful for the native methods: ``IMBALANCE_TOL`` caps the striping
+(BLOCK/MORTON/HILBERT) and graph methods' part loads at ``tol * average``
+(Zoltan's default 1.1 applies to the graph methods; the striping methods
+stay exactly proportional unless the option is set).  The geometric
+methods (RCB/RIB/ZSLAB) split by coordinates and ignore it.
+
 Hierarchical partitioning (``dccrg.hpp:5537-5798``) maps the same machinery
 onto a device hierarchy: first split cells over groups (e.g. hosts/slices,
-DCN level), then within each group (chips on ICI).
+DCN level), then within each group (chips on ICI), recursively for every
+``add_partitioning_level`` call.
 """
 from __future__ import annotations
 
@@ -67,13 +75,20 @@ def compute_partition(
     grid,
     n_parts: int,
     weights: np.ndarray | None,
+    options: dict | None = None,
+    adjacency: tuple | None = None,
 ) -> np.ndarray:
     method = (method or "RCB").upper()
     leaves = grid.leaves
+    # Zoltan treats parameter names case-insensitively (reference forwards
+    # them verbatim to Zoltan_Set_Param) — match that
+    options = {str(k).upper(): v for k, v in (options or {}).items()}
+    tol = options.get("IMBALANCE_TOL")
+    tol = None if tol is None else float(tol)
     if method == "NONE":
         return leaves.owner.copy()
     if method == "BLOCK":
-        return weighted_blocks(np.arange(len(leaves)), weights, n_parts)
+        return weighted_blocks(np.arange(len(leaves)), weights, n_parts, tol)
     if method == "ZSLAB":
         # z-slab by level-0 row, equal rows per part — the ownership the
         # boxed AMR fast path (parallel/boxed.py) requires; restores slab
@@ -91,7 +106,18 @@ def compute_partition(
         centers = grid.geometry.get_center(leaves.cells)
         return rcb_partition(centers, n_parts, weights)
     if method in ("HSFC", "SFC", "HILBERT"):
-        return hilbert_partition(grid.mapping, leaves.cells, n_parts, weights)
-    if method in ("MORTON", "GRAPH", "HYPERGRAPH"):
-        return morton_partition(grid.mapping, leaves.cells, n_parts, weights)
+        return hilbert_partition(grid.mapping, leaves.cells, n_parts, weights, tol)
+    if method == "MORTON":
+        return morton_partition(grid.mapping, leaves.cells, n_parts, weights, tol)
+    if method in ("GRAPH", "HYPERGRAPH"):
+        from .graph import graph_partition
+
+        return graph_partition(
+            grid,
+            n_parts,
+            weights,
+            objective="volume" if method == "HYPERGRAPH" else "cut",
+            imbalance_tol=1.1 if tol is None else tol,
+            adjacency=adjacency,
+        )
     raise ValueError(f"unknown load balancing method {method!r}")

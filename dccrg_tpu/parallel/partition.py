@@ -18,10 +18,30 @@ __all__ = [
 ]
 
 
-def weighted_blocks(order: np.ndarray, weights: np.ndarray | None, n_parts: int) -> np.ndarray:
+def weighted_blocks(
+    order: np.ndarray,
+    weights: np.ndarray | None,
+    n_parts: int,
+    imbalance_tol: float | None = None,
+    nonempty: bool = False,
+) -> np.ndarray:
     """Assign cells (in the given traversal order) to ``n_parts`` contiguous
     blocks of near-equal total weight.  Returns owner per cell (original
-    order)."""
+    order).
+
+    ``imbalance_tol`` plays Zoltan's IMBALANCE_TOL (max part load as a
+    multiple of the average, reference ``dccrg.hpp:5537-5564``): when set
+    and the proportional cuts violate ``max <= avg * tol``, the cuts are
+    recomputed as the minimal-max-load contiguous partition (binary search
+    over the block capacity + greedy fill), the classic linear-partition
+    repair; the repair is kept only when it strictly lowers the max load.
+    ``None`` keeps the plain proportional cuts.
+
+    ``nonempty`` additionally forces the repair whenever the proportional
+    cuts leave a part with zero cells (possible with lumpy weights) and
+    ``n >= n_parts`` — the repair's greedy fill reserves a cell per
+    remaining block, so every part ends up nonempty.
+    """
     n = len(order)
     owner = np.empty(n, dtype=np.int32)
     if weights is None:
@@ -39,14 +59,73 @@ def weighted_blocks(order: np.ndarray, weights: np.ndarray | None, n_parts: int)
         return weighted_blocks(order, None, n_parts)
     # part p gets cells whose cumulative weight falls in (p/n, (p+1)/n]
     part = np.minimum((cum - w / 2) / total * n_parts, n_parts - 1).astype(np.int32)
+    if n_parts > 1:
+        loads = np.bincount(part, weights=w, minlength=n_parts)
+        over_cap = (
+            imbalance_tol is not None
+            and loads.max() > imbalance_tol * total / n_parts
+        )
+        has_empty = (
+            nonempty
+            and n >= n_parts
+            and (np.bincount(part, minlength=n_parts) == 0).any()
+        )
+        if over_cap or has_empty:
+            cand = _min_max_load_blocks(cum, w, n_parts)
+            cand_max = np.bincount(cand, weights=w, minlength=n_parts).max()
+            if has_empty or cand_max < loads.max():
+                part = cand
     owner[order] = part
     return owner
 
 
-def block_partition(cells: np.ndarray, n_parts: int, weights=None) -> np.ndarray:
+def _capacity_fill(cum: np.ndarray, cap: float, n_parts: int) -> np.ndarray | None:
+    """Greedy fill of contiguous blocks with per-block weight <= cap (each
+    block takes at least one cell, and leaves one for every block after it
+    so no block runs empty while cells remain).  Returns the block bounds
+    (cut indices, len n_parts+1) or None if more than ``n_parts`` blocks
+    are needed."""
+    n = len(cum)
+    bounds = [0]
+    start = 0
+    for p in range(n_parts):
+        if start >= n:
+            bounds.append(n)
+            continue
+        base = cum[start - 1] if start else 0.0
+        end = int(np.searchsorted(cum, base + cap, side="right"))
+        end = min(end, n - (n_parts - p - 1))  # reserve for later blocks
+        end = max(end, start + 1)
+        bounds.append(min(end, n))
+        start = bounds[-1]
+    if bounds[-1] < n:
+        return None
+    return np.asarray(bounds, dtype=np.int64)
+
+
+def _min_max_load_blocks(cum: np.ndarray, w: np.ndarray, n_parts: int) -> np.ndarray:
+    """Minimal-max-load contiguous partition of the weight sequence: binary
+    search the smallest feasible block capacity, then greedy-fill."""
+    lo = float(max(w.max(), cum[-1] / n_parts))
+    hi = float(cum[-1])
+    best = _capacity_fill(cum, hi, n_parts)
+    for _ in range(64):
+        mid = 0.5 * (lo + hi)
+        b = _capacity_fill(cum, mid, n_parts)
+        if b is None:
+            lo = mid
+        else:
+            hi, best = mid, b
+    part = np.zeros(len(w), dtype=np.int32)
+    for p in range(n_parts):
+        part[best[p] : best[p + 1]] = p
+    return part
+
+
+def block_partition(cells: np.ndarray, n_parts: int, weights=None, imbalance_tol=None) -> np.ndarray:
     """Contiguous id-order striping (the reference's default initial
     assignment)."""
-    return weighted_blocks(np.arange(len(cells)), weights, n_parts)
+    return weighted_blocks(np.arange(len(cells)), weights, n_parts, imbalance_tol)
 
 
 def _morton_key(indices: np.ndarray) -> np.ndarray:
@@ -60,13 +139,13 @@ def _morton_key(indices: np.ndarray) -> np.ndarray:
     return key
 
 
-def morton_partition(mapping, cells: np.ndarray, n_parts: int, weights=None) -> np.ndarray:
+def morton_partition(mapping, cells: np.ndarray, n_parts: int, weights=None, imbalance_tol=None) -> np.ndarray:
     """Space-filling-curve striping: order leaves along a Morton curve of
     their (center-ish) indices then cut into weight-balanced blocks."""
     ind = mapping.get_indices(cells)
     keys = _morton_key(ind)
     order = np.argsort(keys, kind="stable")
-    return weighted_blocks(order, weights, n_parts)
+    return weighted_blocks(order, weights, n_parts, imbalance_tol)
 
 
 def _hilbert_key(indices: np.ndarray, nbits: int) -> np.ndarray:
@@ -112,7 +191,10 @@ def _hilbert_key(indices: np.ndarray, nbits: int) -> np.ndarray:
     return key
 
 
-def hilbert_partition(mapping, cells: np.ndarray, n_parts: int, weights=None) -> np.ndarray:
+def hilbert_partition(
+    mapping, cells: np.ndarray, n_parts: int, weights=None, imbalance_tol=None,
+    nonempty: bool = False,
+) -> np.ndarray:
     """Hilbert space-filling-curve striping: order leaves along a Hilbert
     curve of their max-resolution indices, cut into weight-balanced blocks."""
     ind = mapping.get_indices(cells)
@@ -120,4 +202,4 @@ def hilbert_partition(mapping, cells: np.ndarray, n_parts: int, weights=None) ->
     nbits = max(1, int(hi).bit_length())
     keys = _hilbert_key(ind, nbits)
     order = np.argsort(keys, kind="stable")
-    return weighted_blocks(order, weights, n_parts)
+    return weighted_blocks(order, weights, n_parts, imbalance_tol, nonempty)

@@ -110,6 +110,175 @@ def test_hierarchical_partitioning():
         assert (ext < full - 1e-9).any()
 
 
+def _refined_cube(method, n=8, levels=1, n_dev=8):
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_maximum_refinement_level(levels)
+        .set_neighborhood_length(1)
+        .set_load_balancing_method(method)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    # refine one corner region to make the adjacency irregular
+    for c in range(1, n * n + 1):
+        g.refine_completely(c)
+    g.stop_refining()
+    return g
+
+
+def test_graph_beats_hilbert_edge_cut():
+    """The honest GRAPH partitioner must measurably reduce the halo edge
+    cut below its own HILBERT seed (reference Zoltan GRAPH via callbacks,
+    dccrg.hpp:11807-12142)."""
+    from dccrg_tpu.parallel.graph import edge_cut, grid_adjacency
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = _refined_cube("HILBERT")
+    start, nbr = grid_adjacency(g)
+    hil = compute_partition("HILBERT", g, 8, None)
+    gra = compute_partition("GRAPH", g, 8, None)
+    cut_h = edge_cut(hil, start, nbr)
+    cut_g = edge_cut(gra, start, nbr)
+    assert cut_g < cut_h
+    # and the load cap held: max part weight <= 1.1 * average
+    counts = np.bincount(gra, minlength=8)
+    assert counts.max() <= 1.1 * counts.sum() / 8 + 1e-9
+    assert counts.min() >= 1
+
+
+def test_hypergraph_reduces_comm_volume():
+    from dccrg_tpu.parallel.graph import comm_volume, grid_adjacency
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = _refined_cube("HILBERT")
+    start, nbr = grid_adjacency(g)
+    hil = compute_partition("HILBERT", g, 8, None)
+    hyp = compute_partition("HYPERGRAPH", g, 8, None)
+    assert comm_volume(hyp, start, nbr) < comm_volume(hil, start, nbr)
+
+
+def test_graph_balance_load_end_to_end():
+    """balance_load under GRAPH keeps physics identical and reduces the
+    total ghost surface vs the HILBERT striping."""
+    gh = _refined_cube("HILBERT")
+    gh.balance_load()
+    gg = _refined_cube("GRAPH")
+    gg.balance_load()
+    np.testing.assert_array_equal(gh.get_cells(), gg.get_cells())
+    ghosts_h = sum(gh.get_ghost_cell_count(d) for d in range(8))
+    ghosts_g = sum(gg.get_ghost_cell_count(d) for d in range(8))
+    assert ghosts_g <= ghosts_h
+
+
+def test_imbalance_tol_option_honored():
+    """IMBALANCE_TOL measurably changes a partition: skewed weights under
+    BLOCK violate the cap with plain proportional cuts; setting the option
+    triggers the min-max-load repair (reference records these as Zoltan
+    params, dccrg.hpp:5537-5564)."""
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = make_grid("BLOCK", length=(9, 1, 1), n_dev=3)
+    w = np.array([4.0, 4, 4, 3, 3, 3, 3, 3, 3])
+    plain = compute_partition("BLOCK", g, 3, w)
+    repaired = compute_partition("BLOCK", g, 3, w, {"IMBALANCE_TOL": 1.05})
+    assert not np.array_equal(plain, repaired)
+    loads_plain = np.bincount(plain, weights=w, minlength=3)
+    loads_rep = np.bincount(repaired, weights=w, minlength=3)
+    # proportional midpoint cuts give a 13-weight part; the min-max repair
+    # finds the optimal contiguous partition (max 12)
+    assert loads_plain.max() == 13.0
+    assert loads_rep.max() == 12.0
+    # and the option is honored through grid.balance_load
+    g.set_partitioning_option("IMBALANCE_TOL", 1.05)
+    for c, wc in enumerate(w, start=1):
+        g.set_cell_weight(c, float(wc))
+    g.balance_load()
+    owners = g.get_owner(g.get_cells())
+    assert np.bincount(owners, weights=w, minlength=3).max() == 12.0
+
+
+def test_imbalance_repair_never_worse_and_nonempty():
+    """The min-max repair is only kept when it strictly lowers the max
+    load, and the nonempty variant never leaves an idle part when there
+    are at least as many cells as parts (fuzzed)."""
+    from dccrg_tpu.parallel.partition import weighted_blocks
+
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        n = int(rng.integers(6, 40))
+        n_parts = int(rng.integers(2, 9))
+        w = rng.integers(1, 10, n).astype(float)
+        order = np.arange(n)
+        plain = weighted_blocks(order, w, n_parts)
+        rep = weighted_blocks(order, w, n_parts, 1.0)
+        max_plain = np.bincount(plain, weights=w, minlength=n_parts).max()
+        max_rep = np.bincount(rep, weights=w, minlength=n_parts).max()
+        assert max_rep <= max_plain
+        ne = weighted_blocks(order, w, n_parts, 1.0, nonempty=True)
+        if n >= n_parts:
+            assert (np.bincount(ne, minlength=n_parts) > 0).all()
+
+
+def test_graph_seed_carries_imbalance_tol():
+    """On a line grid no boundary move improves the cut, so GRAPH returns
+    its seed — the seed itself must already respect IMBALANCE_TOL."""
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = make_grid("GRAPH", length=(9, 1, 1), n_dev=3)
+    w = np.array([4.0, 4, 4, 3, 3, 3, 3, 3, 3])
+    part = compute_partition("GRAPH", g, 3, w, {"IMBALANCE_TOL": 1.05})
+    assert np.bincount(part, weights=w, minlength=3).max() == 12.0
+
+
+def test_multilevel_hierarchical_partitioning():
+    """Three-level HIER (2 groups of 4, pairs of 2, single devices):
+    cell counts must balance at every level of the hierarchy."""
+    g = _refined_cube("RCB")
+    g.add_partitioning_level(4)
+    g.add_partitioning_level(2)
+    g.balance_load()
+    owners = g.get_owner(g.get_cells())
+    n = len(owners)
+    for level_size, n_groups in ((4, 2), (2, 4), (1, 8)):
+        counts = np.bincount(owners // level_size, minlength=n_groups)
+        assert counts.sum() == n
+        # every group at every level holds its proportional share +-25%
+        share = n / n_groups
+        assert counts.max() <= 1.25 * share
+        assert counts.min() >= 0.75 * share
+
+
+def test_hierarchical_nondivisible_devices():
+    """A partitioning level that does not divide the device count forms a
+    remainder group — no device may be left idle."""
+    g = make_grid("RCB", length=(8, 8, 8), n_dev=6)
+    g.add_partitioning_level(4)  # groups of 4 + remainder group of 2
+    g.balance_load()
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=6)
+    assert counts.sum() == 512
+    assert counts.min() > 0
+    share = 512 / 6
+    assert counts.max() <= 1.25 * share and counts.min() >= 0.75 * share
+
+
+def test_graph_refines_tiny_parts():
+    """With fewer than 1/(tol-1) cells per part the load cap is tighter
+    than the seed's own max load; refinement must still be able to trade
+    equal-load moves for cut improvements."""
+    from dccrg_tpu.parallel.graph import edge_cut, grid_adjacency
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = make_grid("GRAPH", length=(5, 4, 1), n_dev=8)
+    start, nbr = grid_adjacency(g)
+    hil = compute_partition("HILBERT", g, 8, None)
+    gra = compute_partition("GRAPH", g, 8, None)
+    assert edge_cut(gra, start, nbr) < edge_cut(hil, start, nbr)
+    counts = np.bincount(gra, minlength=8)
+    assert counts.min() >= 1
+    # balance no worse than the seed's own spread
+    assert counts.max() <= np.bincount(hil, minlength=8).max()
+
+
 def test_balance_after_refinement_with_weights():
     g = (
         Grid()
