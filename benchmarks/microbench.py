@@ -117,13 +117,130 @@ def bench_refinement(length: int):
     }))
 
 
+def bench_checkpoint(length: int):
+    """Million-cell checkpoint round trip (reference save_grid_data /
+    load_grid_data, dccrg.hpp:1089-1716) — payload packing must be
+    offset-indexed scatter, not per-cell Python."""
+    import os
+    import tempfile
+
+    from dccrg_tpu import Grid, make_mesh
+    from dccrg_tpu.io.checkpoint import load_grid_data, save_grid_data
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    spec = {"rho": ((), np.float32), "mom": ((3,), np.float32)}
+    state = g.new_state(spec)
+    cells = g.get_cells()
+    rho = np.sin(cells.astype(np.float64)).astype(np.float32)
+    state = g.set_cell_data(state, "rho", cells, rho)
+    n = len(cells)
+    tmpdir = tempfile.TemporaryDirectory()
+    path = os.path.join(tmpdir.name, "bench.dc")
+
+    from dccrg_tpu.io.checkpoint import start_loading_grid_data
+
+    t0 = time.perf_counter()
+    save_grid_data(g, state, path, spec)
+    t_save = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loader = start_loading_grid_data(path, spec, n_devices=1)
+    t_structure = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    while loader.continue_loading_grid_data():
+        pass
+    g2, state2, _ = loader.finish_loading_grid_data()
+    t_payload = time.perf_counter() - t0
+    np.testing.assert_array_equal(g2.get_cell_data(state2, "rho", cells), rho)
+    file_mb = os.path.getsize(path) / 2**20
+    tmpdir.cleanup()
+    print(json.dumps({
+        "metric": "checkpoint_roundtrip_cells_per_sec",
+        "value": round(n / (t_save + t_structure + t_payload), 1),
+        "unit": "cells/s",
+        "detail": {
+            "n_cells": n,
+            "save_s": round(t_save, 3),
+            # grid re-initialization (epoch/neighbor tables) — paid by any
+            # 1M-cell grid build, not a property of the file format
+            "load_structure_s": round(t_structure, 3),
+            # payload read + unpack + device scatter (the format's cost)
+            "load_payload_s": round(t_payload, 3),
+            "file_mb": round(file_mb, 1),
+        },
+    }))
+
+
+def bench_particles(n_particles: int, length: int = 32):
+    """PIC pushes/s INCLUDING migration (ghost exchange + re-bucketing) —
+    the full per-step cost of the reference's particle test
+    (tests/particles/simple.cpp:285-294), not just the position update."""
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models.particles import Particles
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / length,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(0.0, 1.0, size=(n_particles, 3))
+    # capacity from the actual max occupancy (Poisson tails overflow any
+    # fixed multiple of the mean), doubled for drift during the run
+    occ = np.bincount(g.leaves.position(g.get_existing_cell(pts)))
+    pc = Particles(g, max_particles_per_cell=2 * int(occ.max()))
+
+    t0 = time.perf_counter()
+    state = pc.new_state(pts)
+    t_bucket = time.perf_counter() - t0
+
+    vel = pc.velocity_field(
+        lambda c: np.stack(
+            [0.5 - c[:, 1], c[:, 0] - 0.5, np.full(len(c), 0.05)], axis=-1
+        )
+    )
+    steps = 5
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = pc.step(state, velocity=vel, dt=0.2 / length)
+    secs = time.perf_counter() - t0
+    assert pc.count(state) == n_particles
+    print(json.dumps({
+        "metric": "pic_pushes_per_sec_incl_migration",
+        "value": round(n_particles * steps / secs, 1),
+        "unit": "pushes/s",
+        "detail": {
+            "n_particles": n_particles,
+            "steps": steps,
+            "secs": round(secs, 3),
+            "initial_bucket_s": round(t_bucket, 3),
+            "grid": [length] * 3,
+        },
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1_000_000)
     ap.add_argument("--refine-length", type=int, default=32)
+    ap.add_argument("--checkpoint-length", type=int, default=100)
+    ap.add_argument("--particles", type=int, default=1_000_000)
     args = ap.parse_args()
     bench_geometry(args.n)
     bench_refinement(args.refine_length)
+    bench_checkpoint(args.checkpoint_length)
+    bench_particles(args.particles)
 
 
 if __name__ == "__main__":

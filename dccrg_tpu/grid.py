@@ -509,6 +509,12 @@ class Grid:
         # load balancing cancels pending adaptation (reference: requests
         # are lost after balance_load, dccrg.hpp:2666-2668)
         self.amr.clear()
+        if np.array_equal(owner.astype(np.int32), self.leaves.owner):
+            # no cell moved: every derived table is still valid, skip the
+            # (expensive) epoch rebuild; remap_state degenerates to the
+            # identity (checkpoint reload hits this on its post-replay
+            # balance when the partitioner reproduces the current owners)
+            return self
         self.leaves = LeafSet(cells=self.leaves.cells, owner=owner.astype(np.int32))
         self._rebuild()
         return self
@@ -780,7 +786,8 @@ class Grid:
         reading parent/child data after stop_refining
         (tests/advection/adapter.hpp:230-292).
         """
-        if self._prev_epoch is None:
+        if self._prev_epoch is None or self._prev_epoch is self.epoch:
+            # no structural change (e.g. a no-move balance_load): identity
             return state
         old, new = self._prev_epoch, self.epoch
         policy = policy or {}

@@ -49,25 +49,36 @@ class Particles:
         return self._scatter(state, np.asarray(positions, dtype=np.float64))
 
     def _scatter(self, state, positions):
+        """Bucket (M, 3) positions into their cells' padded slots — one
+        sort + one scatter, no per-particle Python (the reference's
+        per-particle list appends, ``tests/particles/simple.cpp:52-97``,
+        become array ops)."""
         grid = self.grid
         D, R = grid.n_devices, grid.epoch.R
         pos_arr = np.zeros((D, R, self.P, 3))
         cnt = np.zeros((D, R), dtype=np.int32)
         if len(positions):
             cells = grid.get_existing_cell(positions)
-            inside = cells != 0
-            if not inside.all():
+            if not (cells != 0).all():
                 raise ValueError("particles outside the grid")
             lpos = grid.leaves.position(cells)
-            dev = grid.leaves.owner[lpos]
-            row = grid.epoch.row_of[lpos]
-            for d, r, p in zip(dev, row, positions):
-                if cnt[d, r] >= self.P:
-                    raise ValueError(
-                        f"cell capacity exceeded ({self.P} particles/cell)"
-                    )
-                pos_arr[d, r, cnt[d, r]] = p
-                cnt[d, r] += 1
+            dev = grid.leaves.owner[lpos].astype(np.int64)
+            row = grid.epoch.row_of[lpos].astype(np.int64)
+            key = dev * R + row
+            cnt_flat = np.bincount(key, minlength=D * R)
+            if cnt_flat.max() > self.P:
+                raise ValueError(
+                    f"cell capacity exceeded ({self.P} particles/cell)"
+                )
+            cnt = cnt_flat.reshape(D, R).astype(np.int32)
+            # stable sort groups particles by cell, preserving input order
+            # within each cell; the slot is the rank within the group
+            from ..utils.setops import ragged_arange
+
+            order = np.argsort(key, kind="stable")
+            ks = key[order]
+            slot = ragged_arange(cnt_flat[cnt_flat > 0])
+            pos_arr.reshape(D * R, self.P, 3)[ks, slot] = positions[order]
         put = lambda a: jax.device_put(
             jnp.asarray(a), shard_spec(self.grid.mesh, np.ndim(a))
         )
@@ -86,7 +97,10 @@ class Particles:
         def push(state, velocity, dt):
             slot = jnp.arange(self.P)[None, None, :]
             valid = slot < state["number_of_particles"][..., None]
-            moved = state["particles"] + jnp.asarray(velocity) * dt
+            v = jnp.asarray(velocity)
+            if v.ndim == 3:          # per-cell field [D, R, 3]
+                v = v[:, :, None, :]
+            moved = state["particles"] + v * dt
             new = jnp.where(
                 (valid & local[..., None])[..., None], moved, state["particles"]
             )
@@ -94,10 +108,25 @@ class Particles:
 
         return push
 
+    def velocity_field(self, fn) -> np.ndarray:
+        """Per-cell velocity array ``[D, R, 3]`` from a function of cell
+        centers (``fn((M, 3)) -> (M, 3)``) — the reference's per-cell
+        velocity data (``tests/particles/simple.cpp:52-97``) as one dense
+        field the push broadcasts over each cell's particles."""
+        ids = np.asarray(self.grid.epoch.cell_ids)
+        D, R = ids.shape
+        out = np.zeros((D, R, 3))
+        live = ids.ravel() != 0
+        if live.any():
+            centers = self.grid.geometry.get_center(ids.ravel()[live])
+            out.reshape(D * R, 3)[live] = np.asarray(fn(centers))
+        return out
+
     def step(self, state, velocity=(0.1, 0.0, 0.0), dt: float = 1.0):
         """Push particles, refresh ghost copies (counts then coordinates —
         the reference's 2-phase idiom), then hand particles to the cells
-        that now contain them."""
+        that now contain them.  ``velocity`` is a global (3,) vector or a
+        per-cell ``[D, R, 3]`` field (see ``velocity_field``)."""
         state = self._push(state, np.asarray(velocity, dtype=np.float64), dt)
         # phase 1: counts; phase 2: coordinates
         state = {**state, **self._exchange({"number_of_particles": state["number_of_particles"]})}
@@ -116,17 +145,15 @@ class Particles:
     # ------------------------------------------------------------- queries
 
     def positions(self, state) -> np.ndarray:
-        """All particles of local cells, (M, 3)."""
+        """All particles of local cells, (M, 3), in (device, row, slot)
+        order — one boolean gather, no per-row Python."""
         pos = np.asarray(state["particles"])
         cnt = np.asarray(state["number_of_particles"])
         local = np.asarray(self.tables.local_mask)
-        out = []
-        D, R = cnt.shape
-        for d in range(D):
-            rows = np.flatnonzero(local[d])
-            for r in rows:
-                out.append(pos[d, r, : cnt[d, r]])
-        return np.concatenate(out) if out else np.zeros((0, 3))
+        valid = (
+            np.arange(self.P)[None, None, :] < cnt[..., None]
+        ) & local[..., None]
+        return pos[valid]
 
     def count(self, state) -> int:
         cnt = np.asarray(state["number_of_particles"])

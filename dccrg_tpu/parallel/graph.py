@@ -23,6 +23,14 @@ Objectives:
   live on different devices: the halo edge cut.
 * ``"volume"`` (HYPERGRAPH) — total number of (cell, remote part) copies
   the halo exchange must ship: Zoltan PHG's connectivity-1 metric.
+
+Scaling note: candidate *selection* is fully vectorized (boundary-
+restricted count matrix); the accept loop is per-candidate Python.  For
+``"cut"`` it does O(1) work per candidate; ``"volume"``'s exact delta
+walks each candidate's neighbors, so very large HYPERGRAPH balances pay
+an interpreter cost per boundary cell per sweep — acceptable for the
+structural-mutation cadence this is called at, and the place to optimize
+first if that changes.
 """
 from __future__ import annotations
 

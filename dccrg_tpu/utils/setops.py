@@ -16,7 +16,24 @@ import numpy as np
 
 from ..native import native_sort_unique_u64
 
-__all__ = ["unique_u64", "unique_pairs", "csr_take", "counts_to_start"]
+__all__ = [
+    "unique_u64",
+    "unique_pairs",
+    "csr_take",
+    "counts_to_start",
+    "ragged_arange",
+]
+
+
+def ragged_arange(lengths: np.ndarray) -> np.ndarray:
+    """Concatenated ``arange(l)`` for each l in ``lengths`` — the rank of
+    every element within its group (vectorized)."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    total = int(lengths.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    starts = np.cumsum(lengths) - lengths
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
 
 
 def unique_u64(keys: np.ndarray) -> np.ndarray:
