@@ -378,14 +378,24 @@ class Grid:
         return self.halo(hood_id)(state)
 
     def start_remote_neighbor_copy_updates(self, state, hood_id=None):
-        """Split-phase start: dispatches the exchange asynchronously (JAX
-        dispatch is async; compute on other arrays overlaps naturally —
-        the reference's overlap pattern, ``examples/game_of_life.cpp:124-138``)."""
-        return self.halo(hood_id)(state)
+        """Split-phase start (reference ``dccrg.hpp:5010-5105``): launch
+        the ghost-payload collective and return a handle.  The state is
+        untouched, so inner-cell compute can proceed with no data
+        dependence on the transfer — inside one jitted program XLA
+        overlaps them (the reference's overlap pattern,
+        ``examples/game_of_life.cpp:124-138``).  Merge with
+        ``wait_remote_neighbor_copy_updates(state, handle)``."""
+        return self.halo(hood_id).start(state)
 
-    def wait_remote_neighbor_copy_updates(self, state):
-        """Split-phase wait: block until ghost rows are materialized."""
-        return jax.block_until_ready(state)
+    def wait_remote_neighbor_copy_updates(self, state, handle=None, hood_id=None):
+        """Split-phase wait: merge the ``start`` handle's payload into the
+        ghost rows.  The merge is the synchronization — downstream reads of
+        ghost rows now depend on the collective, nothing earlier does.
+        Without a handle (legacy form) this degrades to a blocking ghost
+        refresh."""
+        if handle is None:
+            return self.halo(hood_id)(state)
+        return self.halo(hood_id).finish(state, handle)
 
     # -------------------------------------------------- user neighborhoods
 

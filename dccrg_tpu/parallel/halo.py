@@ -47,6 +47,25 @@ class HaloExchange:
         self.cells_moved = int(hood.pair_counts.sum())
         self._fn = self._build()
 
+    @staticmethod
+    def gather_payload(blk, sr):
+        """Inside a shard_map body: ship this device's send rows of ``blk``
+        (``[R, ...]``) to every peer; returns the received ``[D, S, ...]``
+        payload.  The single definition of the wire protocol — the blocking
+        exchange, the split-phase pair, and workload overlap kernels all
+        call this."""
+        buf = blk[sr]                             # [D, S, ...] rows to send
+        return jax.lax.all_to_all(
+            buf, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    @staticmethod
+    def merge_payload(blk, rr, payload):
+        """Inside a shard_map body: scatter a ``gather_payload`` result
+        into this device's ghost rows."""
+        vals = payload.reshape((-1,) + payload.shape[2:])
+        return blk.at[rr.reshape(-1)].set(vals)
+
     def _build(self):
         mesh = self.mesh
         data_spec = P(SHARD_AXIS)
@@ -59,13 +78,8 @@ class HaloExchange:
 
             def exchange_leaf(x):
                 blk = x[0]                        # [R, ...]
-                buf = blk[sr]                     # [D, S, ...] rows to send
-                recvd = jax.lax.all_to_all(
-                    buf, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=True
-                )                                 # [D, S, ...] from each source
-                flat_rows = rr.reshape(-1)
-                flat_vals = recvd.reshape((-1,) + recvd.shape[2:])
-                return blk.at[flat_rows].set(flat_vals)[None]
+                recvd = HaloExchange.gather_payload(blk, sr)
+                return HaloExchange.merge_payload(blk, rr, recvd)[None]
 
             return jax.tree_util.tree_map(exchange_leaf, state)
 
@@ -80,6 +94,65 @@ class HaloExchange:
 
     def __call__(self, state):
         return self._fn(state)
+
+    # ------------------------------------------------------- split-phase
+
+    def _build_split(self):
+        """Split-phase pair (reference ``dccrg.hpp:5010-5367``): ``start``
+        runs gather + all_to_all and returns the in-flight ghost payload
+        WITHOUT touching the state, so a jitted program can compute on
+        inner cells with no data dependence on the collective (XLA's
+        latency-hiding scheduler overlaps them); ``finish`` scatters the
+        payload into the ghost rows — the data dependence IS the wait."""
+        mesh = self.mesh
+        data_spec = P(SHARD_AXIS)
+        idx_spec = P(SHARD_AXIS, None, None)
+
+        def start_body(send_rows, state):
+            sr = send_rows[0]                     # [D, S]
+            return jax.tree_util.tree_map(
+                lambda x: HaloExchange.gather_payload(x[0], sr)[None], state
+            )
+
+        def finish_body(recv_rows, state, payload):
+            rr = recv_rows[0]
+            return jax.tree_util.tree_map(
+                lambda x, p: HaloExchange.merge_payload(x[0], rr, p[0])[None],
+                state,
+                payload,
+            )
+
+        start = shard_map(
+            start_body,
+            mesh=mesh,
+            in_specs=(idx_spec, data_spec),
+            out_specs=data_spec,
+            check_vma=False,
+        )
+        finish = shard_map(
+            finish_body,
+            mesh=mesh,
+            in_specs=(idx_spec, data_spec, data_spec),
+            out_specs=data_spec,
+            check_vma=False,
+        )
+        self._start_fn = jax.jit(lambda state: start(self.send_rows, state))
+        self._finish_fn = jax.jit(
+            lambda state, payload: finish(self.recv_rows, state, payload)
+        )
+
+    def start(self, state):
+        """Dispatch the ghost-payload collective; returns the handle (a
+        pytree of in-flight ``[D, D, S, ...]`` payloads)."""
+        if not hasattr(self, "_start_fn"):
+            self._build_split()
+        return self._start_fn(state)
+
+    def finish(self, state, payload):
+        """Merge a ``start`` handle's payload into the ghost rows."""
+        if not hasattr(self, "_finish_fn"):
+            self._build_split()
+        return self._finish_fn(state, payload)
 
     def bytes_moved(self, state) -> int:
         """Total payload bytes crossing the mesh per exchange."""

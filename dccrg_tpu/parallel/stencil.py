@@ -15,7 +15,21 @@ import numpy as np
 
 from .mesh import shard_spec
 
-__all__ = ["StencilTables", "gather_neighbors"]
+__all__ = ["StencilTables", "gather_neighbors", "compact_rows"]
+
+
+def compact_rows(mask: np.ndarray, scratch: int) -> np.ndarray:
+    """Per-device padded row lists from a ``[D, R]`` bool mask: returns
+    ``[D, W]`` int32 with each device's True rows first and the scratch row
+    as padding.  The compacted form lets split-phase kernels compute
+    exactly the inner (or outer) cells instead of masking all R rows."""
+    D, R = mask.shape
+    counts = mask.sum(axis=1)
+    W = max(int(counts.max()) if D else 0, 1)
+    rows = np.full((D, W), scratch, dtype=np.int32)
+    for d in range(D):
+        rows[d, : counts[d]] = np.flatnonzero(mask[d])
+    return rows
 
 
 class StencilTables:

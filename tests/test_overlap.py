@@ -1,0 +1,199 @@
+"""Split-phase halo (communication/computation overlap) tests — the
+reference's defining scaling pattern (dccrg.hpp:5010-5367; canonical use
+examples/game_of_life.cpp:124-138): start the ghost transfer, compute
+inner cells while it is in flight, wait, compute outer cells."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models import GameOfLife
+
+
+def make_grid(length=(10, 10, 1), n_dev=8, method="RCB", max_ref=0):
+    g = (
+        Grid()
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(1)
+        .set_load_balancing_method(method)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    return g
+
+
+GLIDER = [35, 36, 37, 27, 16]
+
+
+def test_split_phase_api_matches_blocking_exchange():
+    """start + wait(handle) must leave ghost rows exactly as the blocking
+    refresh does."""
+    g = make_grid()
+    state = g.new_state({"v": ((), np.float64)})
+    cells = g.get_cells()
+    state = g.set_cell_data(state, "v", cells, np.sin(cells.astype(np.float64)))
+    blocking = g.update_copies_of_remote_neighbors(state)
+    handle = g.start_remote_neighbor_copy_updates(state)
+    merged = g.wait_remote_neighbor_copy_updates(state, handle)
+    np.testing.assert_array_equal(
+        np.asarray(blocking["v"]), np.asarray(merged["v"])
+    )
+
+
+def test_inner_compute_unaffected_by_transfer():
+    """Inner cells (no remote neighbors) gather only local rows, so their
+    results computed BEFORE the merge equal the blocking step's."""
+    g = make_grid()
+    gol_b = GameOfLife(g)
+    gol_o = GameOfLife(g, overlap=True)
+    state = gol_b.new_state(alive_cells=GLIDER)
+    sb = gol_b.step(state)
+    so = gol_o.step(state)
+    hood = g.epoch.hoods[None]
+    inner = np.asarray(hood.inner_mask)
+    np.testing.assert_array_equal(
+        np.asarray(sb["is_alive"])[inner], np.asarray(so["is_alive"])[inner]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sb["live_neighbor_count"])[inner],
+        np.asarray(so["live_neighbor_count"])[inner],
+    )
+
+
+@pytest.mark.parametrize("n_dev", [1, 8])
+def test_overlap_step_identical_physics(n_dev):
+    g = make_grid(n_dev=n_dev)
+    gol_b = GameOfLife(g)
+    gol_o = GameOfLife(g, overlap=True)
+    sb = gol_b.new_state(alive_cells=GLIDER)
+    so = gol_o.new_state(alive_cells=GLIDER)
+    for _ in range(8):
+        sb = gol_b.step(sb)
+        so = gol_o.step(so)
+        assert set(gol_b.alive_cells(sb).tolist()) == set(
+            gol_o.alive_cells(so).tolist()
+        )
+        # all local rows identical, counts included
+        local = np.asarray(g.epoch.local_mask)
+        np.testing.assert_array_equal(
+            np.asarray(sb["is_alive"])[local],
+            np.asarray(so["is_alive"])[local],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sb["live_neighbor_count"])[local],
+            np.asarray(so["live_neighbor_count"])[local],
+        )
+
+
+def test_overlap_on_refined_grid():
+    """Inner/outer split must respect AMR neighbor structure too."""
+    g = make_grid(length=(8, 8, 1), max_ref=1)
+    g.refine_completely(1)
+    g.refine_completely(28)
+    g.stop_refining()
+    g.balance_load()
+    gol_b = GameOfLife(g)
+    gol_o = GameOfLife(g, overlap=True)
+    rng = np.random.default_rng(3)
+    cells = g.get_cells()
+    alive0 = cells[rng.random(len(cells)) < 0.4]
+    sb = gol_b.new_state(alive_cells=alive0)
+    so = gol_o.new_state(alive_cells=alive0)
+    for _ in range(5):
+        sb = gol_b.step(sb)
+        so = gol_o.step(so)
+    assert set(gol_b.alive_cells(sb).tolist()) == set(
+        gol_o.alive_cells(so).tolist()
+    )
+
+
+def test_overlap_covers_every_local_cell():
+    """Compacted inner + outer row sets partition the local rows."""
+    from dccrg_tpu.parallel.stencil import compact_rows
+
+    g = make_grid(length=(6, 6, 6))
+    hood = g.epoch.hoods[None]
+    scratch = g.epoch.R - 1
+    for d in range(g.n_devices):
+        inner = set(np.flatnonzero(np.asarray(hood.inner_mask)[d]).tolist())
+        outer = set(np.flatnonzero(np.asarray(hood.outer_mask)[d]).tolist())
+        local = set(np.flatnonzero(np.asarray(g.epoch.local_mask)[d]).tolist())
+        assert inner | outer == local
+        assert not (inner & outer)
+    rows = compact_rows(np.asarray(hood.inner_mask), scratch)
+    for d in range(g.n_devices):
+        got = set(rows[d].tolist()) - {scratch}
+        assert got == set(np.flatnonzero(np.asarray(hood.inner_mask)[d]).tolist())
+
+
+def test_collective_independent_of_inner_compute():
+    """The overlap property itself, checked on the step's dataflow graph:
+    inside the jitted split-phase step, the ghost collective (all_to_all)
+    must not depend on any result of the inner-cell compute, and the
+    inner-cell results must not depend on the collective — that mutual
+    independence is exactly what lets a parallel runtime (TPU async
+    collectives, XLA latency-hiding scheduler) run them concurrently."""
+    import jax
+
+    g = make_grid(length=(8, 8, 8))
+    gol = GameOfLife(g, overlap=True)
+    state = gol.new_state(alive_cells=GLIDER)
+    jaxpr = jax.make_jaxpr(gol._step)(state)
+
+    # collect equations of the (single) inner shard_map body
+    def find_eqns(jpr, out):
+        for eqn in jpr.eqns:
+            out.append(eqn)
+            for v in eqn.params.values():
+                for vv in v if isinstance(v, (list, tuple)) else (v,):
+                    if hasattr(vv, "jaxpr"):      # ClosedJaxpr
+                        vv = vv.jaxpr
+                    if hasattr(vv, "eqns"):       # open Jaxpr
+                        find_eqns(vv, out)
+
+    eqns = []
+    find_eqns(jaxpr.jaxpr, eqns)
+    a2a = [e for e in eqns if "all_to_all" in str(e.primitive)]
+    assert len(a2a) == 1, "expected exactly one collective in the step"
+    a2a = a2a[0]
+
+    # ancestors of a var: all vars transitively feeding it (a jaxpr
+    # Literal has .val and no producer; skip it)
+    producers = {}
+    for e in eqns:
+        for ov in e.outvars:
+            producers[id(ov)] = e
+
+    def ancestors(vs):
+        seen = set()
+        stack = [v for v in vs if not hasattr(v, "val")]
+        while stack:
+            v = stack.pop()
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            e = producers.get(id(v))
+            if e is not None:
+                stack.extend(iv for iv in e.invars if not hasattr(iv, "val"))
+        return seen
+
+    a2a_ancestors = ancestors(a2a.invars)
+    a2a_out_ids = {id(v) for v in a2a.outvars}
+
+    # "inner compute" = the integer-sum reductions NOT downstream of the
+    # collective; at least one reduction (the inner count) must be fully
+    # independent of it in both directions
+    reduces = [
+        e for e in eqns if str(e.primitive) in ("reduce_sum", "reduce_and", "add_any")
+        and e not in (a2a,)
+    ]
+    independent = []
+    for e in reduces:
+        anc = ancestors(e.invars)
+        if not (anc & a2a_out_ids):            # doesn't read the collective
+            out_ids = {id(v) for v in e.outvars}
+            if not (out_ids & a2a_ancestors):  # collective doesn't read it
+                independent.append(e)
+    assert independent, (
+        "no reduction is dataflow-independent of the collective — the "
+        "split-phase step lost its overlap structure"
+    )
