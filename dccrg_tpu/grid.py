@@ -772,7 +772,11 @@ class Grid:
         over with ``remap_state``."""
         self._assert_initialized()
         from .amr.refinement import commit_adaptation
+        from .utils.collectives import sync_adaptation
 
+        # multi-controller agreement: every process commits the union of
+        # all processes' queued requests (identity under one controller)
+        sync_adaptation(self.amr)
         self._prev_epoch = self.epoch
         new_cells, removed = commit_adaptation(self)
         self._last_new_cells = new_cells

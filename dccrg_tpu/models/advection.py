@@ -401,7 +401,60 @@ class Advection:
             return jnp.min(s)
 
         self._max_dt = max_dt
-        self._max_diff = None
+
+        # AMR refinement indicator on the dense layout (adapter.hpp:71-110
+        # runs on the same data the solver uses — so does this): max
+        # relative density difference to the 6 face neighbors as shifted
+        # slices, with open-boundary faces masked out (the solver's own
+        # mx/my masks; mxn/myn are their negative-side rolls) and z
+        # through the slab halo ring
+        mxp, myp = mx, my
+        mxn = jnp.roll(mxp, 1, 2)
+        myn = jnp.roll(myp, 1, 1)
+
+        def md_body(zf_up, zf_dn, rho, thr):
+            rho = rho[0]
+
+            def rel(a, b):
+                return jnp.abs(a - b) / (jnp.minimum(a, b) + thr)
+
+            rho_e = extend(rho)
+            md = rel(rho, jnp.roll(rho, -1, 2)) * mxp
+            md = jnp.maximum(md, rel(rho, jnp.roll(rho, 1, 2)) * mxn)
+            md = jnp.maximum(md, rel(rho, jnp.roll(rho, -1, 1)) * myp)
+            md = jnp.maximum(md, rel(rho, jnp.roll(rho, 1, 1)) * myn)
+            md = jnp.maximum(md, rel(rho, rho_e[2:]) * zf_up[0][:, None, None])
+            md = jnp.maximum(md, rel(rho, rho_e[:-2]) * zf_dn[0][:, None, None])
+            return (md[None],)
+
+        fn_md = shard_map(
+            md_body,
+            mesh=mesh,
+            in_specs=(data_spec, data_spec, data_spec, P()),
+            out_specs=(data_spec,),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def dense_max_diff(state, diff_threshold):
+            (md,) = fn_md(
+                zf_up_dev, zf_dn_dev, state["density"],
+                jnp.asarray(diff_threshold, dtype),
+            )
+            return {**state, "max_diff": md}
+
+        self._max_diff = dense_max_diff
+
+    def _dense_to_rows(self, state):
+        """Dense [D, nzl, ny, nx] state -> general [D, R] row-layout state
+        (vectorized per field)."""
+        grid = self.grid
+        cells = grid.get_cells()
+        row_state = grid.new_state(self.spec)
+        for name in self.spec:
+            vals = self.get_cell_data(state, name, cells)
+            row_state = grid.set_cell_data(row_state, name, cells, vals)
+        return row_state
 
     def _dense_coords(self, ids):
         """(device, local z, y, x) of given cell ids in the dense layout."""
@@ -510,11 +563,10 @@ class Advection:
         return float(self._max_dt(state))
 
     def compute_max_diff(self, state, diff_threshold: float):
-        if self._max_diff is None:
-            raise NotImplementedError(
-                "max_diff on the dense path: rebuild with allow_dense=False "
-                "(AMR decisions use the general path)"
-            )
+        """AMR refinement indicator on whatever layout the model runs
+        (dense shifted-slice or general gather) — no rebuild needed to
+        decide adaptation, matching the reference running its indicator on
+        the solver's own data (adapter.hpp:71-110)."""
         return self._max_diff(state, diff_threshold)
 
     # --------------------------------------------------------- AMR driver
@@ -555,6 +607,22 @@ class Advection:
         field at the new cell centers (adapter.hpp:300-310).  Returns a NEW
         Advection bound to the new grid structure plus the remapped state."""
         grid = self.grid
+        if self.dense is not None:
+            if not (grid.amr.to_refine or grid.amr.to_unrefine):
+                # nothing queued: the grid stays uniform, so commit the
+                # (empty) adaptation and KEEP the dense fast path — a
+                # no-op adapt cycle must not degrade every later step
+                new_cells = grid.stop_refining()
+                removed = grid.get_removed_cells()
+                adv = Advection(
+                    grid, self.hood_id, self.dtype,
+                    use_pallas=self.use_pallas,
+                )
+                return adv, state, new_cells, removed
+            # the dense z-slab layout is about to stop existing (the grid
+            # refines): convert to the row layout remap_state speaks,
+            # while the pre-commit epoch is still current
+            state = self._dense_to_rows(state)
         new_cells = grid.stop_refining()
         removed = grid.get_removed_cells()
         state = grid.remap_state(

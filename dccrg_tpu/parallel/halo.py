@@ -25,7 +25,19 @@ from jax import shard_map
 
 from .mesh import SHARD_AXIS
 
-__all__ = ["HaloExchange"]
+__all__ = ["HaloExchange", "HaloHandle"]
+
+
+class HaloHandle:
+    """In-flight ghost payload returned by ``HaloExchange.start`` — a
+    distinct type so passing it where a *state* belongs (the pre-rewrite
+    split-phase calling convention) fails loudly instead of silently
+    exchanging garbage."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload
 
 
 class HaloExchange:
@@ -93,6 +105,11 @@ class HaloExchange:
         return jax.jit(lambda state: fn(self.send_rows, self.recv_rows, state))
 
     def __call__(self, state):
+        if isinstance(state, HaloHandle):
+            raise TypeError(
+                "got a HaloHandle where a state pytree belongs — pass the "
+                "handle as wait_remote_neighbor_copy_updates(state, handle)"
+            )
         return self._fn(state)
 
     # ------------------------------------------------------- split-phase
@@ -141,18 +158,24 @@ class HaloExchange:
             lambda state, payload: finish(self.recv_rows, state, payload)
         )
 
-    def start(self, state):
-        """Dispatch the ghost-payload collective; returns the handle (a
-        pytree of in-flight ``[D, D, S, ...]`` payloads)."""
+    def start(self, state) -> HaloHandle:
+        """Dispatch the ghost-payload collective; returns a ``HaloHandle``
+        wrapping the in-flight ``[D, D, S, ...]`` payload pytree."""
+        if isinstance(state, HaloHandle):
+            raise TypeError("start() takes the state, not a HaloHandle")
         if not hasattr(self, "_start_fn"):
             self._build_split()
-        return self._start_fn(state)
+        return HaloHandle(self._start_fn(state))
 
-    def finish(self, state, payload):
+    def finish(self, state, handle: HaloHandle):
         """Merge a ``start`` handle's payload into the ghost rows."""
+        if not isinstance(handle, HaloHandle):
+            raise TypeError(
+                "finish() expects the HaloHandle returned by start()"
+            )
         if not hasattr(self, "_finish_fn"):
             self._build_split()
-        return self._finish_fn(state, payload)
+        return self._finish_fn(state, handle.payload)
 
     def bytes_moved(self, state) -> int:
         """Total payload bytes crossing the mesh per exchange."""

@@ -1,29 +1,148 @@
-"""Reduction utilities — the role of the reference's MPI support layer
-(``dccrg_mpi_support.hpp``: ``All_Gather`` ``:98-231``, ``All_Reduce``
-``:237-266``, ``Some_Reduce`` ``:282-377``).
+"""Host-metadata collectives — the role of the reference's MPI support
+layer (``dccrg_mpi_support.hpp``: ``All_Gather`` ``:98-231``,
+``All_Reduce`` ``:237-266``, ``Some_Reduce`` ``:282-377``).
 
-Device-wide reductions belong in jitted code (``jnp.sum``/``jnp.min`` over
-sharded arrays lower to XLA collectives over ICI); these helpers cover the
-host-side metadata reductions the reference does between ranks.  Under a
-single controller an "All_Gather" is trivially the array itself — kept as a
-named function so call sites document intent and a future multi-controller
-backend (jax.distributed) has one seam to fill.
+Two regimes:
+
+* **Device-wide reductions** belong in jitted code (``jnp.sum``/``jnp.min``
+  over sharded arrays lower to XLA collectives over ICI) — nothing here.
+* **Host-side metadata** (refine-request sets, directory updates, cell
+  weights) must agree across *controllers*.  Under JAX's single-controller
+  model one Python process drives every device, so agreement is free and
+  the helpers degenerate to identities.  Under multi-controller SPMD
+  (``jax.distributed.initialize``, one process per host, the deployment
+  the reference reaches with one MPI rank per node) each process holds its
+  own copies, and the helpers below really move data: variable-length
+  uint64 sets travel as (length allgather, padded payload allgather) via
+  ``jax.experimental.multihost_utils.process_allgather``, which lowers to
+  an XLA all_gather across processes over ICI/DCN.
+
+The multi-controller path is exercised degenerately by the 1-process case
+and, in tests, by substituting the transport (see
+``tests/test_collectives.py``); ARCHITECTURE.md §multi-host records what a
+full multi-host deployment additionally requires.
 """
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["all_gather", "all_reduce", "some_reduce", "halo_peers"]
+__all__ = [
+    "process_count",
+    "allgather_u64",
+    "allgather_u64_multi",
+    "union_u64",
+    "sync_adaptation",
+    "all_gather",
+    "all_reduce",
+    "some_reduce",
+    "halo_peers",
+]
+
+
+def process_count() -> int:
+    """Number of controller processes (1 unless jax.distributed is up)."""
+    import jax
+
+    return jax.process_count()
+
+
+def _process_allgather(x: np.ndarray) -> np.ndarray:
+    """Transport seam: gather one fixed-shape array from every process;
+    returns ``[P, *x.shape]``.  Split out so tests can substitute a fake
+    multi-process transport."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def allgather_u64_multi(arrays: list) -> list[list]:
+    """Gather several variable-length uint64 arrays from every process in
+    ONE (lengths, payload) collective pair — the wire format for all
+    id-set agreement (the reference's ``All_Gather`` of cell-id lists,
+    ``dccrg_mpi_support.hpp:98-231``).  Returns ``out[p][i]`` = process
+    p's i-th array.  Single-controller: ``[arrays]``.
+
+    Wire format: one ``[k]`` length vector gather, then the concatenated
+    payloads padded to the max total — two fixed-shape collectives, which
+    is all ``process_allgather`` speaks, independent of how many sets
+    travel together.
+    """
+    arrays = [np.ascontiguousarray(a, dtype=np.uint64) for a in arrays]
+    if process_count() == 1:
+        return [arrays]
+    k = len(arrays)
+    lens = np.asarray([len(a) for a in arrays], dtype=np.int64)
+    all_lens = _process_allgather(lens)               # [P, k]
+    cap = max(int(all_lens.sum(axis=1).max()), 1)
+    buf = np.zeros(cap, dtype=np.uint64)
+    cat = np.concatenate(arrays) if k else buf[:0]
+    buf[: len(cat)] = cat
+    bufs = _process_allgather(buf)                    # [P, cap]
+    out = []
+    for p in range(len(bufs)):
+        bounds = np.concatenate(([0], np.cumsum(all_lens[p])))
+        out.append([bufs[p, bounds[i] : bounds[i + 1]] for i in range(k)])
+    return out
+
+
+def allgather_u64(values: np.ndarray) -> list[np.ndarray]:
+    """Every process's (variable-length) uint64 array, visible
+    everywhere.  Single-controller: ``[values]``."""
+    return [row[0] for row in allgather_u64_multi([values])]
+
+
+def union_u64(values) -> np.ndarray:
+    """Sorted union of every process's uint64 set — how structural
+    mutation requests (refine/unrefine/veto sets) reach agreement before a
+    commit: each controller queues requests for cells it knows about, the
+    union is what the deterministic commit pipeline runs on everywhere
+    (reference: per-rank request lists merged in ``dccrg.hpp:3461-3485``'s
+    all-to-all of induced refines)."""
+    arr = (
+        values
+        if isinstance(values, np.ndarray)
+        else np.fromiter(values, dtype=np.uint64)
+    )
+    parts = allgather_u64(arr)
+    return np.unique(np.concatenate(parts))
+
+
+def sync_adaptation(queues) -> None:
+    """Merge every controller's AMR request queues in place — the
+    agreement step before ``commit_adaptation`` runs the deterministic
+    veto→induce→override→execute pipeline on identical inputs everywhere.
+    Unions are correct for requests (any controller's request stands) and
+    for vetoes (any controller's veto stands), matching the reference's
+    cross-rank request exchange (``dccrg.hpp:3461-3485``).  Identity with
+    one controller."""
+    if process_count() == 1:
+        return
+    names = ("to_refine", "to_unrefine", "not_to_refine", "not_to_unrefine")
+    rows = allgather_u64_multi(
+        [np.fromiter(getattr(queues, name), dtype=np.uint64) for name in names]
+    )
+    for i, name in enumerate(names):
+        merged = np.unique(np.concatenate([row[i] for row in rows]))
+        setattr(queues, name, {int(c) for c in merged})
 
 
 def all_gather(per_device_values) -> list:
-    """Every device's value, visible everywhere (reference All_Gather)."""
+    """Every device's value, visible everywhere (reference All_Gather).
+    Per-device metadata lives replicated on the controller, so this is the
+    list itself; cross-process gathering is ``allgather_u64``."""
     return list(per_device_values)
 
 
 def all_reduce(per_device_values, op=np.add):
-    """Reduce all devices' values to one result (reference All_Reduce)."""
-    return op.reduce(np.asarray(per_device_values), axis=0)
+    """Reduce all devices' values to one result (reference All_Reduce).
+    Under multiple controllers each process reduces its devices' values
+    locally, the partials are gathered, and ``op`` reduces them again —
+    valid for any associative ufunc (add, minimum, maximum, ...)."""
+    local = op.reduce(np.asarray(per_device_values), axis=0)
+    if process_count() == 1:
+        return local
+    parts = _process_allgather(np.asarray(local))
+    return op.reduce(parts, axis=0)
 
 
 def halo_peers(grid, device: int, hood_id=None) -> np.ndarray:

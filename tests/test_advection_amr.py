@@ -76,3 +76,106 @@ def test_amr_structure_device_count_invariant():
         adv, state, _, _ = adv.adapt_grid(state)
         structs.append(g.get_cells())
     np.testing.assert_array_equal(structs[0], structs[1])
+
+
+@pytest.mark.parametrize(
+    "periodic", [(True, True, False), (False, False, False)]
+)
+def test_dense_max_diff_matches_general_path(periodic):
+    """The dense-layout AMR indicator (shifted slices + slab ring) computes
+    exactly the general gather path's values — the fast path can feed
+    check_for_adaptation without a rebuild (adapter.hpp:71-110 runs on the
+    solver's own data).  Both periodic and open x/y exercise the
+    boundary-face masks against the general path."""
+    def build(dense):
+        g = (
+            Grid()
+            .set_initial_length((8, 8, 8))
+            .set_maximum_refinement_level(1)
+            .set_neighborhood_length(0)
+            .set_periodic(*periodic)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(0.125, 0.125, 0.125),
+            )
+            .initialize(mesh=make_mesh(n_devices=8))
+        )
+        return g, Advection(g, allow_dense=dense)
+
+    gd, advd = build(True)
+    gg, advg = build(False)
+    assert advd.dense is not None and advg.dense is None
+    sd = advd.initialize_state()
+    sg = advg.initialize_state()
+    sd = advd.compute_max_diff(sd, 0.25)
+    sg = advg.compute_max_diff(sg, 0.25)
+    cells = gd.get_cells()
+    np.testing.assert_allclose(
+        advd.get_cell_data(sd, "max_diff", cells),
+        advg.get_cell_data(sg, "max_diff", cells),
+        rtol=1e-12, atol=1e-14,
+    )
+
+
+def test_dense_path_drives_amr_to_first_refine():
+    """AMR driver runs on the dense fast path until the first refinement
+    commits; adapt_grid converts the z-slab state to the row layout and
+    hands over to the general path with mass intact."""
+    g = (
+        Grid()
+        .set_initial_length((10, 10, 1))
+        .set_maximum_refinement_level(2)
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, False)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(0.1, 0.1, 0.1),
+        )
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    adv = Advection(g)
+    assert adv.dense is not None
+    state = adv.initialize_state()
+    dt = 0.25 * adv.max_time_step(state)
+    state = adv.run(state, 3, dt)
+    m0 = adv.total_mass(state)
+    state = adv.check_for_adaptation(state)
+    adv2, state, new_cells, removed = adv.adapt_grid(state)
+    assert len(new_cells) > 0
+    assert adv2.dense is None
+    check_two_to_one(g)
+    assert adv2.total_mass(state) == pytest.approx(m0, rel=1e-10)
+    # and the handed-over state keeps stepping
+    state = adv2.step(state, 0.25 * adv2.max_time_step(state))
+    assert adv2.total_mass(state) == pytest.approx(m0, rel=1e-10)
+
+
+def test_noop_adapt_keeps_dense_path():
+    """An adapt cycle that queues nothing must not degrade the model off
+    the dense fast path."""
+    g = (
+        Grid()
+        .set_initial_length((8, 8, 8))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(0.125, 0.125, 0.125),
+        )
+        .initialize(mesh=make_mesh(n_devices=8))
+    )
+    adv = Advection(g)
+    assert adv.dense is not None
+    state = adv.initialize_state()
+    m0 = adv.total_mass(state)
+    # no check_for_adaptation: queues are empty
+    adv2, state, new_cells, removed = adv.adapt_grid(state)
+    assert len(new_cells) == 0 and len(removed) == 0
+    assert adv2.dense is not None
+    assert adv2.total_mass(state) == pytest.approx(m0, rel=1e-12)
+    state = adv2.step(state, 0.25 * adv2.max_time_step(state))
+    assert adv2.total_mass(state) == pytest.approx(m0, rel=1e-10)

@@ -1,0 +1,113 @@
+"""Multi-controller metadata collectives (the reference's MPI support
+layer, dccrg_mpi_support.hpp) — degenerate single-process behavior plus
+the real multi-controller wire path exercised through a substituted
+transport (SURVEY.md §2.4 seam)."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+from dccrg_tpu.utils import collectives
+
+
+def test_single_process_degenerate():
+    assert collectives.process_count() == 1
+    vals = np.array([3, 1, 2], dtype=np.uint64)
+    parts = collectives.allgather_u64(vals)
+    assert len(parts) == 1
+    np.testing.assert_array_equal(parts[0], vals)
+    np.testing.assert_array_equal(
+        collectives.union_u64({5, 2, 9}), np.array([2, 5, 9], dtype=np.uint64)
+    )
+    assert collectives.all_reduce([1.0, 2.0, 3.0]) == 6.0
+
+
+class _FakeTransport:
+    """Simulates P processes: process_allgather returns this process's
+    array stacked with pre-baked peer arrays."""
+
+    def __init__(self, monkeypatch, peer_payloads):
+        self.peers = peer_payloads          # list of dicts: shape -> array
+        monkeypatch.setattr(
+            collectives, "process_count", lambda: 1 + len(peer_payloads)
+        )
+        monkeypatch.setattr(collectives, "_process_allgather", self)
+        self.calls = 0
+
+    def __call__(self, x):
+        # first call per collective gathers lengths, second gathers padded
+        # payloads; peers answer from their scripted sequences
+        rows = [np.asarray(x)]
+        for peer in self.peers:
+            rows.append(np.asarray(peer.pop(0)))
+        self.calls += 1
+        return np.stack(rows)
+
+
+def test_allgather_u64_wire_format(monkeypatch):
+    """Variable-length gather = length gather + padded payload gather."""
+    peer = [
+        np.array([2], dtype=np.int64),                # peer's length
+        np.array([7, 8, 0], dtype=np.uint64),         # peer's padded payload
+    ]
+    _FakeTransport(monkeypatch, [peer])
+    parts = collectives.allgather_u64(np.array([1, 2, 3], dtype=np.uint64))
+    assert len(parts) == 2
+    np.testing.assert_array_equal(parts[0], [1, 2, 3])
+    np.testing.assert_array_equal(parts[1], [7, 8])   # trimmed to length 2
+
+
+def test_union_and_allreduce_across_processes(monkeypatch):
+    peer = [
+        np.array([2], dtype=np.int64),
+        np.array([5, 2], dtype=np.uint64),
+    ]
+    _FakeTransport(monkeypatch, [peer])
+    np.testing.assert_array_equal(
+        collectives.union_u64(np.array([2, 9], dtype=np.uint64)), [2, 5, 9]
+    )
+    _FakeTransport(monkeypatch, [[np.asarray(10.0)]])
+    assert collectives.all_reduce([1.0, 2.0]) == 13.0  # 3 local + 10 remote
+
+
+def test_stop_refining_merges_remote_requests(monkeypatch):
+    """End-to-end through the grid: a refine request queued by a (mocked)
+    remote controller is committed locally — every process runs the
+    deterministic commit pipeline on the union of requests, keeping the
+    replicated leaf directory identical everywhere."""
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=2))
+    )
+    g.refine_completely(1)                 # local request: cell 1
+    # remote controller requested cell 16; all four queues (to_refine,
+    # to_unrefine, not_to_refine, not_to_unrefine) travel in ONE
+    # lengths-vector + padded-payload collective pair
+    peer = [
+        np.array([1, 0, 0, 0], dtype=np.int64),   # peer queue lengths
+        np.array([16], dtype=np.uint64),          # concatenated payload
+    ]
+    _FakeTransport(monkeypatch, [peer])
+    new_cells = g.stop_refining()
+    # both cells are gone from the leaf set (refined into children)
+    assert not g.leaves.exists(np.uint64(1))
+    assert not g.leaves.exists(np.uint64(16))
+    assert len(new_cells) == 16            # two cells x 8 children
+
+
+def test_sync_adaptation_identity_single_process():
+    from dccrg_tpu.utils.collectives import sync_adaptation
+
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=2))
+    )
+    g.refine_completely(3)
+    before = set(g.amr.to_refine)
+    sync_adaptation(g.amr)
+    assert g.amr.to_refine == before

@@ -197,3 +197,15 @@ def test_collective_independent_of_inner_compute():
         "no reduction is dataflow-independent of the collective — the "
         "split-phase step lost its overlap structure"
     )
+
+
+def test_stale_split_phase_convention_raises():
+    """The pre-handle calling convention (passing the start() result where
+    a state belongs) must fail loudly, not exchange garbage."""
+    g = make_grid()
+    state = g.new_state({"v": ((), np.float64)})
+    handle = g.start_remote_neighbor_copy_updates(state)
+    with pytest.raises(TypeError, match="HaloHandle"):
+        g.wait_remote_neighbor_copy_updates(handle)       # old pattern
+    with pytest.raises(TypeError, match="HaloHandle"):
+        g.wait_remote_neighbor_copy_updates(state, state)  # swapped args
