@@ -175,6 +175,33 @@ def bench_checkpoint(length: int):
     }))
 
 
+def bench_epoch_rebuild(length: int = 64):
+    """Full derived-state rebuild (neighbor lists, inverse lists, halo
+    schedules, gather tables, iteration masks) — the host-side cost every
+    AMR commit and load balance pays (reference: the tails of
+    dccrg.hpp:3461-3485 / 3741-4147)."""
+    from dccrg_tpu import Grid, make_mesh
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    n = length**3
+    # time the rebuild itself (balance_load skips it when no cell moves,
+    # which is guaranteed on the single device this may run on)
+    t0 = time.perf_counter()
+    g._rebuild()
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "epoch_rebuild_cells_per_sec",
+        "value": round(n / secs, 1),
+        "unit": "cells/s",
+        "detail": {"n_cells": n, "hood": 26, "secs": round(secs, 3)},
+    }))
+
+
 def bench_particles(n_particles: int, length: int = 32):
     """PIC pushes/s INCLUDING migration (ghost exchange + re-bucketing) —
     the full per-step cost of the reference's particle test
@@ -240,6 +267,7 @@ def main():
     bench_geometry(args.n)
     bench_refinement(args.refine_length)
     bench_checkpoint(args.checkpoint_length)
+    bench_epoch_rebuild()
     bench_particles(args.particles)
 
 

@@ -193,6 +193,13 @@ def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
     )
     unrefined.sort()
 
+    if not len(refined) and not len(unrefined):
+        # nothing survived the override passes: the leaf set is untouched,
+        # skip rebuilding (and re-sorting) all N leaves
+        queues.clear()
+        empty = np.zeros(0, dtype=np.uint64)
+        return empty, empty.copy()
+
     # --- build the new leaf set
     new_children = mapping.get_all_children(refined).reshape(-1) if len(refined) else np.zeros(0, np.uint64)
     removed_families = mapping.get_siblings(unrefined) if len(unrefined) else np.zeros((0, 8), np.uint64)

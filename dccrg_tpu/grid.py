@@ -765,22 +765,29 @@ class Grid:
             unresolved[idx[exists]] = False
         return out
 
-    def stop_refining(self, sorted: bool = True) -> np.ndarray:
+    def stop_refining(self, sorted: bool = True, presynced: bool = False) -> np.ndarray:
         """Commit all queued refines/unrefines (veto -> induce -> override
         -> execute, reference ``dccrg.hpp:3461-3485``); returns the new
         cells.  Payload states allocated before this call must be carried
-        over with ``remap_state``."""
+        over with ``remap_state``.  ``presynced`` skips the multi-controller
+        queue union for callers that already ran ``sync_adaptation``."""
         self._assert_initialized()
         from .amr.refinement import commit_adaptation
         from .utils.collectives import sync_adaptation
 
         # multi-controller agreement: every process commits the union of
         # all processes' queued requests (identity under one controller)
-        sync_adaptation(self.amr)
+        if not presynced:
+            sync_adaptation(self.amr)
         self._prev_epoch = self.epoch
         new_cells, removed = commit_adaptation(self)
         self._last_new_cells = new_cells
         self._last_removed_cells = removed
+        if not len(new_cells) and not len(removed):
+            # nothing changed (nothing queued, or everything vetoed): the
+            # leaf set was left untouched, keep the current epoch and
+            # every derived table instead of paying a full rebuild
+            return new_cells.copy()
         self._rebuild()
         return new_cells.copy()
 

@@ -608,22 +608,26 @@ class Advection:
         Advection bound to the new grid structure plus the remapped state."""
         grid = self.grid
         if self.dense is not None:
+            # decide from the GLOBAL queues: another controller may have
+            # queued requests this process hasn't seen (sync is idempotent
+            # and called symmetrically on every process)
+            from ..utils.collectives import sync_adaptation
+
+            sync_adaptation(grid.amr)
             if not (grid.amr.to_refine or grid.amr.to_unrefine):
-                # nothing queued: the grid stays uniform, so commit the
-                # (empty) adaptation and KEEP the dense fast path — a
-                # no-op adapt cycle must not degrade every later step
-                new_cells = grid.stop_refining()
-                removed = grid.get_removed_cells()
-                adv = Advection(
-                    grid, self.hood_id, self.dtype,
-                    use_pallas=self.use_pallas,
-                )
-                return adv, state, new_cells, removed
+                # nothing queued anywhere: the grid stays uniform, the
+                # (empty) commit keeps the current epoch, and this model —
+                # dense tables, jitted kernels and all — remains valid; a
+                # no-op adapt cycle must not degrade or recompile anything
+                new_cells = grid.stop_refining(presynced=True)
+                return self, state, new_cells, grid.get_removed_cells()
             # the dense z-slab layout is about to stop existing (the grid
             # refines): convert to the row layout remap_state speaks,
             # while the pre-commit epoch is still current
             state = self._dense_to_rows(state)
-        new_cells = grid.stop_refining()
+            new_cells = grid.stop_refining(presynced=True)
+        else:
+            new_cells = grid.stop_refining()
         removed = grid.get_removed_cells()
         state = grid.remap_state(
             state,

@@ -255,7 +255,10 @@ def _finish_hood(
                 rrow[m] = epoch.rows_on_device(d, gp[m])
         recv_rows[rd, sd, in_grp] = rrow
 
-    # --- neighbor gather tables over local rows (flat one-pass scatters)
+    # --- neighbor gather tables over local rows (flat one-pass scatters).
+    # Every 26M-edge intermediate is computed once and reused: the same
+    # (source, neighbor) edge arrays feed the gather tables AND the
+    # inner/outer split below.
     counts = np.diff(lists.start)
     Kmax = int(counts.max()) if N else 1
     Kmax = max(Kmax, 1)
@@ -265,12 +268,19 @@ def _finish_hood(
     nbr_len = np.zeros((D, R, Kmax), dtype=np.int32)
     nbr_slot = np.zeros((D, R, Kmax), dtype=np.int32)
     E = int(lists.start[-1])
+    is_outer = np.zeros(N, dtype=bool)
     if E:
+        from ..utils.setops import ragged_arange
+
         esrc = np.repeat(np.arange(N), counts)
-        ecol = np.arange(E, dtype=np.int64) - np.repeat(lists.start[:-1], counts)
-        edev = owner[esrc]
-        flat = (edev * R + epoch.row_of[esrc]) * Kmax + ecol
+        ecol = ragged_arange(counts)
+        # one N-sized precompute replaces two E-sized gathers + arithmetic
+        grow = owner * np.int64(R) + epoch.row_of.astype(np.int64)
+        flat = grow[esrc] * np.int64(Kmax) + ecol
+        if flat.size and D * R * Kmax < np.iinfo(np.int32).max:
+            flat = flat.astype(np.int32)  # halves scatter index traffic
         # row of each neighbor on the source's device
+        edev = owner[esrc]
         nrows = np.empty(E, dtype=np.int64)
         local_e = owner[lists.nbr_pos] == edev
         nrows[local_e] = epoch.row_of[lists.nbr_pos[local_e]]
@@ -285,15 +295,13 @@ def _finish_hood(
         nbr_len.reshape(-1)[flat] = len_all[lists.nbr_pos]
         nbr_slot.reshape(-1)[flat] = lists.slot
 
-    # --- inner/outer split (dccrg.hpp:7478-7519): outer = local cell with a
-    # remote cell among neighbors_of or neighbors_to
-    src_of = np.repeat(np.arange(N), counts)
-    remote_of = owner[src_of] != owner[lists.nbr_pos]
-    src_to = np.repeat(np.arange(N), np.diff(to_start))
-    remote_to = owner[src_to] != owner[to_src]
-    is_outer = np.zeros(N, dtype=bool)
-    is_outer[src_of[remote_of]] = True
-    is_outer[src_to[remote_to]] = True
+        # --- inner/outer split (dccrg.hpp:7478-7519): outer = local cell
+        # with a remote cell among neighbors_of or neighbors_to.  A remote
+        # edge (i -> j, owners differ) makes i outer via neighbors_of and
+        # j outer via neighbors_to — the `rem` edge set already found
+        # above covers both directions, no to_start/to_src pass needed.
+        is_outer[esrc[rem]] = True
+        is_outer[lists.nbr_pos[rem]] = True
     inner_mask = np.zeros((D, R), dtype=bool)
     outer_mask = np.zeros((D, R), dtype=bool)
     for d in range(D):

@@ -175,6 +175,9 @@ def test_noop_adapt_keeps_dense_path():
     # no check_for_adaptation: queues are empty
     adv2, state, new_cells, removed = adv.adapt_grid(state)
     assert len(new_cells) == 0 and len(removed) == 0
+    # no structural change: the SAME model (tables, compiled kernels) is
+    # returned — no rebuild, no recompile
+    assert adv2 is adv
     assert adv2.dense is not None
     assert adv2.total_mass(state) == pytest.approx(m0, rel=1e-12)
     state = adv2.step(state, 0.25 * adv2.max_time_step(state))
