@@ -54,10 +54,8 @@ _HBM_PEAK_GBPS = {
 }
 
 
-def _best_of(f, n=3):
-    """Run f n times; returns (min_secs, all_times, last_out).  Min is the
-    capability estimate (tunnel slowdowns are one-sided); the full list is
-    recorded so run-to-run variance is visible in BENCH detail."""
+def _timed_runs(f, n):
+    """Run f n times; returns (all_times, last_out)."""
     import jax
 
     times = []
@@ -67,7 +65,24 @@ def _best_of(f, n=3):
         out = f()
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
-    return min(times), times, out
+    return times, out
+
+
+def _median_of(f, n=8):
+    """Run f n times; returns (median_secs, all_times, last_out).
+
+    The headline uses the median, not the min: the shared chip shows a
+    bimodal on-device distribution (mode ~0.096 s for the 5000-step fused
+    kernel, a rare ~0.06 s fast mode appearing stochastically), so a
+    min-of-few estimator swings ~1.6x round-over-round depending on
+    whether it catches the fast mode.  That is exactly what happened
+    between BENCH_r01 (86.5 B/s — fast mode caught) and BENCH_r02
+    (52.6 B/s — not caught); see `regression_attribution` in detail.
+    The median is the stable tenant-visible throughput."""
+    import statistics
+
+    times, out = _timed_runs(f, n)
+    return statistics.median(times), times, out
 
 
 def _uniform_grid(shape, n_devices=None):
@@ -101,11 +116,7 @@ def measure_tpu() -> dict:
     dt = np.float32(0.4 * adv.max_time_step(state))  # D2H: sync is armed
 
     jax.block_until_ready(adv.run(state, 2, dt))     # warmup + compile
-    # best of 5: the device is reached through a shared tunnel whose
-    # slowdowns are one-sided noise, so min time estimates capability;
-    # the full times list is recorded for variance (round-2 review item:
-    # a 39% round-over-round swing went unattributed)
-    secs, times, out = _best_of(lambda: adv.run(state, STEPS, dt), n=5)
+    secs, times, out = _median_of(lambda: adv.run(state, STEPS, dt), n=8)
 
     n_cells = NX * NY * NZ
     updates_per_s = n_cells * STEPS / secs
@@ -114,6 +125,7 @@ def measure_tpu() -> dict:
     return {
         "updates_per_s": updates_per_s,
         "updates_per_s_per_chip": updates_per_s / n_dev,
+        "best_updates_per_s_per_chip": n_cells * STEPS / min(times) / n_dev,
         "n_devices": n_dev,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -160,7 +172,7 @@ def measure_refined() -> dict:
     state = adv.initialize_state()
     dt = np.float32(0.4 * adv.max_time_step(state))
     jax.block_until_ready(adv.run(state, 2, dt))
-    secs, times, _ = _best_of(lambda: adv.run(state, REFINED_STEPS, dt))
+    secs, times, _ = _median_of(lambda: adv.run(state, REFINED_STEPS, dt), n=5)
     return {
         "n_cells": n_cells,
         "levels": sorted(adv.boxed.boxes),
@@ -189,7 +201,7 @@ def measure_large() -> dict:
     state = adv.initialize_state()
     dt = np.float32(0.4 * adv.max_time_step(state))
     jax.block_until_ready(adv.run(state, 2, dt))
-    secs, times, _ = _best_of(lambda: adv.run(state, LARGE_STEPS, dt))
+    secs, times, _ = _median_of(lambda: adv.run(state, LARGE_STEPS, dt), n=5)
     n_cells = nx * ny * nz
     # HBM roofline: the per-step kernel streams rho + 3 velocities in and
     # rho out — 5 f32 arrays of n_cells per step (halo planes are noise)
@@ -362,8 +374,26 @@ def main():
         "halo_GBps": round(tpu["halo_GBps"], 3),
         "cpu_baseline_updates_per_s": cpu,
         "dtype": "float32",
-        # run-to-run variance of the headline (value = best of these)
+        # run-to-run variance of the headline (value = median of these)
         "headline_times_s": tpu.get("times"),
+        "headline_estimator": "median",
+        "best_observed_updates_per_s_per_chip": round(
+            tpu["best_updates_per_s_per_chip"], 1
+        ),
+        # Round-2 review item 4: the 86.5 B (r01) -> 52.6 B (r02) headline
+        # swing was bisected by running the identical 15-rep headline at the
+        # round-1 snapshot (134888e) and at HEAD on the same chip: both show
+        # the same distribution (mode ~0.096 s, rare ~0.058-0.067 s fast
+        # mode appearing in BOTH versions), and the only round-2 change to
+        # ops/dense_advection.py gates the per-step streaming kernel, which
+        # the headline's whole-block fused kernel does not use.  The swing
+        # was a min-of-few estimator catching the chip's stochastic fast
+        # mode in r01 and missing it in r02 — environment, not code.
+        "regression_attribution": (
+            "r01->r02 swing = min-of-few estimator x bimodal shared-chip "
+            "timing; identical distributions measured at r1 snapshot and "
+            "HEAD; headline now reports the median"
+        ),
     }
     if extras.get("refined"):
         ref = extras["refined"]
