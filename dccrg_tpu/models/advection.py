@@ -294,23 +294,45 @@ class Advection:
             flux_update_fits,
             fused_run_fits,
             make_flux_update,
+            make_flux_update_blocked,
             make_fused_run,
             pallas_available,
+            pick_step_block,
         )
 
         pallas_update = None
+        blocked_update = None
+        step_block = 0
         use_pallas = getattr(self, "use_pallas", True)
         # use_pallas="interpret" forces the kernels through the Pallas
         # interpreter so CI (CPU) exercises the full integration path
         interpret = use_pallas == "interpret"
-        if use_pallas and (
-            interpret or (pallas_available(dtype) and flux_update_fits(ny, nx))
-        ):
-            pallas_update = make_flux_update(
-                nzl, ny, nx, area, 1.0 / vol, interpret=interpret
-            )
-            mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
-            my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
+        if use_pallas and (interpret or pallas_available(dtype)):
+            step_block = pick_step_block(nzl, ny, nx)
+            if step_block >= 2:
+                blocked_update = make_flux_update_blocked(
+                    nzl, ny, nx, step_block, area, 1.0 / vol,
+                    interpret=interpret,
+                )
+            elif interpret or flux_update_fits(ny, nx):
+                pallas_update = make_flux_update(
+                    nzl, ny, nx, area, 1.0 / vol, interpret=interpret
+                )
+            if blocked_update is not None or pallas_update is not None:
+                mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
+                my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
+
+        def halo_stacks(blk, B):
+            """Per-block z-halo planes for the blocked kernel: row k of
+            (lo, hi) holds the plane below/above block k — interior rows
+            are strided slices of blk, the edge rows are the
+            ppermute-received device-boundary planes."""
+            below, above = extend.planes(blk)
+            if nzl // B == 1:
+                return below, above
+            lo = jnp.concatenate([below, blk[B - 1:-1:B]], axis=0)
+            hi = jnp.concatenate([blk[B::B], above], axis=0)
+            return lo, hi
 
         # Negative-side x/y faces: the flux through cell i's negative face
         # equals the positive-side face flux of cell i-1, i.e.
@@ -318,17 +340,34 @@ class Advection:
         # Accumulation follows the general path's slot order (z-, y-, x-,
         # x+, y+, z+); negative-side face flux enters the cell with +,
         # positive-side leaves with - (solve.hpp:227-233).
+        def blocked_step(rho, vx, vy, vz, v_lo, v_hi, mzu, mzd, dt):
+            """One blocked-kernel step given prebuilt vz halo stacks —
+            shared by step() (stacks rebuilt per call: vz is an input) and
+            the multi-step run (stacks hoisted out of the loop)."""
+            r_lo, r_hi = halo_stacks(rho, step_block)
+            return blocked_update(
+                rho, r_lo, r_hi, vx, vy, vz, v_lo, v_hi, mx3, my3,
+                mzu, mzd, dt,
+            )
+
         def body(zf_up, zf_dn, rho, vx, vy, vz, dt):
             rho, vx, vy, vz = rho[0], vx[0], vy[0], vz[0]
             mz_up = zf_up[0][:, None, None]
             mz_dn = zf_dn[0][:, None, None]
+
+            if blocked_update is not None:
+                v_lo, v_hi = halo_stacks(vz, step_block)
+                new_rho = blocked_step(
+                    rho, vx, vy, vz, v_lo, v_hi, mz_up, mz_dn, dt
+                )
+                return (new_rho[None],)
+
             rho_e = extend(rho)
             vz_e = extend(vz)
 
             if pallas_update is not None:
                 new_rho = pallas_update(
-                    rho_e, vx, vy, vz_e, mx3, my3,
-                    zf_up[0].reshape(nzl, 1, 1), zf_dn[0].reshape(nzl, 1, 1), dt,
+                    rho_e, vx, vy, vz_e, mx3, my3, mz_up, mz_dn, dt,
                 )
                 return (new_rho[None],)
 
@@ -368,7 +407,8 @@ class Advection:
         # the entire run loop executes inside one kernel launch with zero
         # HBM traffic between steps — compute-bound instead of HBM-bound
         self._fused_run = None
-        if pallas_update is not None and D == 1 and fused_run_fits(nzl, ny, nx):
+        have_pallas = pallas_update is not None or blocked_update is not None
+        if have_pallas and D == 1 and fused_run_fits(nzl, ny, nx):
             fused = make_fused_run(
                 nzl, ny, nx, area, 1.0 / vol, interpret=interpret
             )
@@ -384,6 +424,46 @@ class Advection:
                 return {**state, "density": new_rho[None]}
 
             self._fused_run = fused_run_fn
+
+        # Blocked multi-step run: the whole fori_loop inside one shard_map
+        # so the constant vz halo stacks are built once per run call, not
+        # once per step (the generic run path re-derives them every
+        # iteration because the step body cannot know vz is loop-invariant)
+        self._dense_run = None
+        if blocked_update is not None:
+
+            def run_body(zf_up, zf_dn, rho, vx, vy, vz, dt, steps):
+                rho, vx, vy, vz = rho[0], vx[0], vy[0], vz[0]
+                mzu = zf_up[0][:, None, None]
+                mzd = zf_dn[0][:, None, None]
+                v_lo, v_hi = halo_stacks(vz, step_block)
+
+                def one(i, r):
+                    return blocked_step(
+                        r, vx, vy, vz, v_lo, v_hi, mzu, mzd, dt
+                    )
+
+                out = jax.lax.fori_loop(0, steps, one, rho)
+                return (out[None],)
+
+            run_sm = shard_map(
+                run_body,
+                mesh=mesh,
+                in_specs=(data_spec,) * 6 + (P(), P()),
+                out_specs=(data_spec,),
+                check_vma=False,
+            )
+
+            @jax.jit
+            def dense_run_fn(state, steps, dt):
+                (new_rho,) = run_sm(
+                    zf_up_dev, zf_dn_dev,
+                    state["density"], state["vx"], state["vy"], state["vz"],
+                    jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
+                )
+                return {**state, "density": new_rho}
+
+            self._dense_run = dense_run_fn
 
         dx = self._dx
 
@@ -547,6 +627,10 @@ class Advection:
             )
         if getattr(self, "_boxed_run", None) is not None:
             return self._boxed_run(
+                state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
+            )
+        if getattr(self, "_dense_run", None) is not None:
+            return self._dense_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if not hasattr(self, "_run"):

@@ -30,6 +30,8 @@ except Exception:  # pragma: no cover
 __all__ = [
     "pallas_available",
     "make_flux_update",
+    "make_flux_update_blocked",
+    "pick_step_block",
     "make_fused_run",
     "fused_run_fits",
 ]
@@ -163,6 +165,127 @@ def make_flux_update(nzl: int, ny: int, nx: int, area, inv_vol: float,
             dt_arr, rho_ext, rho_ext, rho_ext, vx, vy,
             vz_ext, vz_ext, vz_ext, mx, my, mz_up, mz_dn,
         )
+
+    return update
+
+
+#: scoped-VMEM cap for the blocked per-step kernel (v5e has ~128 MB)
+_STEP_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def pick_step_block(nzl: int, ny: int, nx: int) -> int:
+    """Largest z-block size B (a divisor of nzl, >=2) whose blocked-kernel
+    VMEM residency fits the raised scoped budget; 0 if none does.
+
+    Residency model: the 5 input + 1 output center blocks double-buffered
+    (12B planes) plus ~8B planes of kernel temporaries plus the 12
+    single-plane halo/DMA buffers — ~(20B + 12) plane-sized arrays.
+    Larger B amortizes the halo re-reads: HBM traffic per step is
+    ~(5 + 4/B) full arrays instead of the plane kernel's ~13 (which
+    re-reads the +-1 z views of rho and vz three times each and
+    re-materializes both halo-extended copies every step)."""
+    plane = ny * nx * 4
+    for b in (16, 8, 4, 2):
+        if nzl % b == 0 and (20 * b + 12) * plane <= _STEP_VMEM_BUDGET:
+            return b
+    return 0
+
+
+def make_flux_update_blocked(nzl: int, ny: int, nx: int, block: int, area,
+                             inv_vol: float, *, interpret: bool = False):
+    """Blocked per-step kernel: ``update(rho, rho_lo, rho_hi, vx, vy, vz,
+    vz_lo, vz_hi, mx, my, mz_up, mz_dn, dt) -> new_rho``.
+
+    Each program handles a ``block``-plane z-slab; z-neighbor values are
+    in-VMEM rolls with the block-edge planes spliced in from the per-block
+    halo stacks ``*_lo``/``*_hi`` (shape ``[nzl/block, ny, nx]``: row k
+    holds the plane below/above block k — built host-side from strided
+    slices plus the ppermute-received device-boundary planes).  Unlike
+    make_flux_update there is no halo-extended array: rho is read ~once
+    per step instead of three times, and nothing is concatenated in HBM."""
+    assert nzl % block == 0 and block >= 2
+    m = nzl // block
+    area_x, area_y, area_z = (float(a) for a in area)
+    inv_vol = float(inv_vol)
+    roll_m1, roll_p1 = _make_rolls(interpret)
+
+    def kernel(dt_ref, r_c, r_lo, r_hi, vx, vy, vz_c, vz_lo, vz_hi,
+               mx, my, mzu, mzd, out):
+        dt = dt_ref[0]
+        r = r_c[...]
+        zidx = jax.lax.broadcasted_iota(jnp.int32, (block, ny, nx), 0)
+        # plane j's z-neighbors: j+-1 within the block, halo stacks at the
+        # block edges (the roll wraps there, so the splice overwrites it)
+        r_up = jnp.where(zidx == block - 1, r_hi[...], roll_m1(r, 0))
+        r_dn = jnp.where(zidx == 0, r_lo[...], roll_p1(r, 0))
+        vz = vz_c[...]
+        vz_up = jnp.where(zidx == block - 1, vz_hi[...], roll_m1(vz, 0))
+        vz_dn = jnp.where(zidx == 0, vz_lo[...], roll_p1(vz, 0))
+
+        rxp = roll_m1(r, 2)
+        vfx = (vx[...] + roll_m1(vx[...], 2)) * 0.5
+        fx = jnp.where(vfx >= 0, r, rxp) * (dt * vfx * area_x)
+        fx = fx * mx[...]
+
+        ryp = roll_m1(r, 1)
+        vfy = (vy[...] + roll_m1(vy[...], 1)) * 0.5
+        fy = jnp.where(vfy >= 0, r, ryp) * (dt * vfy * area_y)
+        fy = fy * my[...]
+
+        vfz_hi = (vz + vz_up) * 0.5
+        fz = jnp.where(vfz_hi >= 0, r, r_up) * (dt * vfz_hi * area_z)
+        fz = fz * mzu[...]
+        vfz_lo = (vz_dn + vz) * 0.5
+        fzd = jnp.where(vfz_lo >= 0, r_dn, r) * (dt * vfz_lo * area_z)
+        fzd = fzd * mzd[...]
+
+        # accumulate in the XLA body's slot order: z-, y-, x-, x+, y+, z+
+        flux = fzd
+        flux = flux + roll_p1(fy, 1)
+        flux = flux + roll_p1(fx, 2)
+        flux = flux - fx
+        flux = flux - fy
+        flux = flux - fz
+        out[...] = r + flux * inv_vol
+
+    cspec = pl.BlockSpec(
+        (block, ny, nx), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM
+    )
+    hspec = pl.BlockSpec(
+        (1, ny, nx), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM
+    )
+    mxspec = pl.BlockSpec((1, 1, nx), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM)
+    myspec = pl.BlockSpec((1, ny, 1), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM)
+    mzspec = pl.BlockSpec((block, 1, 1), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM)
+
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_STEP_VMEM_BUDGET
+        )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(m,),
+            in_specs=[
+                cspec, hspec, hspec,           # rho + halo stacks
+                cspec, cspec,                  # vx, vy
+                cspec, hspec, hspec,           # vz + halo stacks
+                mxspec, myspec, mzspec, mzspec,
+            ],
+            out_specs=cspec,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nzl, ny, nx), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+    def update(rho, rho_lo, rho_hi, vx, vy, vz, vz_lo, vz_hi,
+               mx, my, mz_up, mz_dn, dt):
+        dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
+        return call(dt_arr, rho, rho_lo, rho_hi, vx, vy, vz, vz_lo, vz_hi,
+                    mx, my, mz_up, mz_dn)
 
     return update
 

@@ -71,12 +71,18 @@ class HaloExtend:
     def __call__(self, blk):
         """blk: [nzl, ny, nx] (or with trailing dims). Returns [nzl+2, ...].
         For a single device the ring degenerates to a local wrap."""
+        recv_below, recv_above = self.planes(blk)
+        return jnp.concatenate([recv_below, blk, recv_above], axis=0)
+
+    def planes(self, blk):
+        """The two received halo planes ``(below, above)`` without
+        materializing the concatenated extension — for kernels that splice
+        the halo in VMEM instead of re-reading an extended copy from HBM."""
         info = self.info
         top = blk[-1:]                       # plane sent upward
         bot = blk[:1]                        # plane sent downward
         if info.n_devices == 1:
-            recv_below, recv_above = top, bot
-        else:
-            recv_below = jax.lax.ppermute(top, SHARD_AXIS, self.up)
-            recv_above = jax.lax.ppermute(bot, SHARD_AXIS, self.down)
-        return jnp.concatenate([recv_below, blk, recv_above], axis=0)
+            return top, bot
+        recv_below = jax.lax.ppermute(top, SHARD_AXIS, self.up)
+        recv_above = jax.lax.ppermute(bot, SHARD_AXIS, self.down)
+        return recv_below, recv_above

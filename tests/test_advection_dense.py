@@ -84,9 +84,10 @@ def test_dense_single_device():
 
 @pytest.mark.parametrize("periodic", [(True, True, True), (True, True, False)])
 def test_pallas_integration_interpret(periodic):
-    """The full Advection Pallas wiring (plane kernel in step(), fused
-    whole-block kernel in run(), mask reshapes, device-dim handling) runs
-    via the Pallas interpreter on CPU and matches the XLA dense path."""
+    """The full Advection Pallas wiring (blocked per-step kernel in
+    step(), fused whole-block kernel in run(), mask reshapes, device-dim
+    handling) runs via the Pallas interpreter on CPU and matches the XLA
+    dense path."""
     g, _ = make(periodic=periodic, n_dev=1)
     pal = Advection(g, dtype=np.float32, use_pallas="interpret")
     xla = Advection(g, dtype=np.float32, use_pallas=False)
@@ -105,6 +106,71 @@ def test_pallas_integration_interpret(periodic):
     )
 
     a = pal.run(s0, 5, dt)
+    b = s0
+    for _ in range(5):
+        b = xla.step(b, dt)
+    np.testing.assert_allclose(
+        np.asarray(a["density"]), np.asarray(b["density"]), rtol=1e-6, atol=1e-9
+    )
+
+
+def test_plane_kernel_interpret():
+    """The fallback plane kernel (make_flux_update) still engages and
+    matches XLA when no block size divides nzl (odd z extent) — the
+    blocked kernel cannot be built there."""
+    from dccrg_tpu.ops.dense_advection import pick_step_block
+
+    g, _ = make(nz=7, n_dev=1)
+    assert pick_step_block(7, 8, 8) == 0
+    pal = Advection(g, dtype=np.float32, use_pallas="interpret")
+    xla = Advection(g, dtype=np.float32, use_pallas=False)
+    assert pal._dense_run is None  # blocked path did not engage
+
+    s0 = pal.initialize_state()
+    cells = g.get_cells()
+    vz = 0.3 * np.sin(2 * np.pi * g.geometry.get_center(cells)[:, 2])
+    s0 = pal.set_cell_data(s0, "vz", cells, vz.astype(np.float32))
+    dt = np.float32(0.4 * pal.max_time_step(s0))
+    a = pal.step(s0, dt)
+    b = xla.step(s0, dt)
+    np.testing.assert_allclose(
+        np.asarray(a["density"]), np.asarray(b["density"]), rtol=2e-7, atol=1e-9
+    )
+
+
+@pytest.mark.parametrize("periodic", [(True, True, True), (True, True, False)])
+@pytest.mark.parametrize("nz,n_dev", [(32, 1), (32, 4)])
+def test_blocked_kernel_interpret(periodic, nz, n_dev):
+    """The blocked per-step kernel (multi-plane z-blocks, halo stacks
+    spliced in VMEM) matches the XLA dense path — with several blocks per
+    device (m>1, interior strided-slice halo rows) and across devices
+    (ppermute-received edge rows)."""
+    from dccrg_tpu.ops.dense_advection import pick_step_block
+
+    g, _ = make(nz=nz, periodic=periodic, n_dev=n_dev)
+    pal = Advection(g, dtype=np.float32, use_pallas="interpret")
+    xla = Advection(g, dtype=np.float32, use_pallas=False)
+    nzl = nz // n_dev
+    assert pick_step_block(nzl, 8, 8) >= 2  # blocked path engages
+    assert pal._dense_run is not None
+
+    s0 = pal.initialize_state()
+    cells = g.get_cells()
+    vz = 0.3 * np.sin(2 * np.pi * g.geometry.get_center(cells)[:, 2])
+    s0 = pal.set_cell_data(s0, "vz", cells, vz.astype(np.float32))
+    dt = np.float32(0.4 * pal.max_time_step(s0))
+
+    a = pal.step(s0, dt)
+    b = xla.step(s0, dt)
+    np.testing.assert_allclose(
+        np.asarray(a["density"]), np.asarray(b["density"]), rtol=2e-7, atol=1e-9
+    )
+
+    # the hoisted multi-step run matches stepping (called directly: on one
+    # device run() would prefer the whole-block fused kernel)
+    import jax.numpy as jnp
+
+    a = pal._dense_run(s0, jnp.asarray(5, jnp.int32), dt)
     b = s0
     for _ in range(5):
         b = xla.step(b, dt)
