@@ -13,7 +13,13 @@ import subprocess
 
 import numpy as np
 
-__all__ = ["native_find_neighbors", "native_sort_unique_u64", "native_available"]
+__all__ = [
+    "native_find_neighbors",
+    "native_sort_unique_u64",
+    "native_invert_and_pairs",
+    "native_fill_tables",
+    "native_available",
+]
 
 _DIR = pathlib.Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libneighbor_kernels.so"
@@ -53,6 +59,7 @@ def _load():
         u8p,                             # periodic
         i64p, ctypes.c_int64,            # hood
         u64p, ctypes.c_int64,            # src_cells
+        ctypes.c_int,                    # uniform
         ctypes.c_int, ctypes.c_int,      # strict, emit
         i64p,                            # counts
         i64p,                            # out_start
@@ -61,6 +68,30 @@ def _load():
     ]
     lib.sort_unique_u64.restype = ctypes.c_int64
     lib.sort_unique_u64.argtypes = [u64p, ctypes.c_int64]
+    lib.hood_invert_and_pairs.restype = ctypes.c_int64
+    lib.hood_invert_and_pairs.argtypes = [
+        i64p, i64p,                      # start, nbr_pos
+        ctypes.c_int64, ctypes.c_int64,  # N, E
+        i64p, ctypes.c_int64,            # owner, D
+        i64p, i64p,                      # to_start, to_src
+        u8p,                             # is_outer
+        u64p, ctypes.POINTER(ctypes.c_int64),  # pair_bitmap, n_pairs
+        i64p,                            # tmp
+    ]
+    lib.extract_pairs.restype = ctypes.c_int64
+    lib.extract_pairs.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+    ]
+    lib.hood_fill_tables.restype = None
+    lib.hood_fill_tables.argtypes = [
+        i64p, i64p, i64p, i32p,          # start, nbr_pos, offset3, slot
+        ctypes.c_int64, ctypes.c_int64,  # N, E
+        i64p, i64p, i64p,                # owner, row_of, len_all
+        i64p, i64p,                      # ghost_concat, ghost_start
+        i64p,                            # n_local
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # D, R, Kmax
+        i32p, u8p, i32p, i32p, i32p,     # tables
+    ]
     _lib = lib
     return _lib
 
@@ -92,6 +123,15 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
     hood = np.ascontiguousarray(hood, dtype=np.int64)
     leaves_cells = np.ascontiguousarray(leaves_cells, dtype=np.uint64)
     src_cells = np.ascontiguousarray(src_cells, dtype=np.uint64)
+    # uniform level-0 grid: leaves are exactly [1..n0], so every position
+    # lookup is id-1 — the per-edge binary search disappears
+    n0 = int(np.prod(grid_len))
+    uniform = int(
+        len(leaves_cells) == n0
+        and n0 > 0
+        and leaves_cells[0] == 1
+        and leaves_cells[-1] == n0
+    )
     counts = np.zeros(n_src, dtype=np.int64)
     bad_cell = ctypes.c_uint64(0)
     bad_slot = ctypes.c_int64(0)
@@ -101,7 +141,7 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
 
     rc = lib.find_neighbors(
         leaves_cells, len(leaves_cells), grid_len, mapping.max_refinement_level,
-        periodic, hood, len(hood), src_cells, n_src, int(strict), 0,
+        periodic, hood, len(hood), src_cells, n_src, uniform, int(strict), 0,
         counts, dummy64, dummyu, dummy64, dummy64, dummy32,
         ctypes.byref(bad_cell), ctypes.byref(bad_slot),
     )
@@ -119,7 +159,7 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
     out_slot = np.zeros(E, dtype=np.int32)
     rc = lib.find_neighbors(
         leaves_cells, len(leaves_cells), grid_len, mapping.max_refinement_level,
-        periodic, hood, len(hood), src_cells, n_src, int(strict), 1,
+        periodic, hood, len(hood), src_cells, n_src, uniform, int(strict), 1,
         counts, start, out_nbr, out_pos,
         out_offset.reshape(-1), out_slot,
         ctypes.byref(bad_cell), ctypes.byref(bad_slot),
@@ -129,3 +169,75 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
             f"neighbor {bad_cell.value} is not an existing leaf (2:1 violation?)"
         )
     return start, out_nbr, out_pos, out_offset, out_slot
+
+
+def native_invert_and_pairs(start, nbr_pos, owner, n_devices):
+    """Fused inverse-CSR + ghost-pair + inner/outer pass (C++).  Returns
+    ``(to_start, to_src, pairs, is_outer)`` or None if unavailable or the
+    D*N pair bitmap would be unreasonably large."""
+    lib = _load()
+    if lib is None:
+        return None
+    N = len(start) - 1
+    E = int(start[-1])
+    D = int(n_devices)
+    n_bits = D * max(N, 1)
+    if n_bits > (1 << 33):         # 1 GiB of bitmap — fall back to numpy
+        return None
+    start = np.ascontiguousarray(start, dtype=np.int64)
+    nbr_pos = np.ascontiguousarray(nbr_pos, dtype=np.int64)
+    owner = np.ascontiguousarray(owner, dtype=np.int64)
+    to_start = np.zeros(N + 1, dtype=np.int64)
+    to_src = np.zeros(max(E, 1), dtype=np.int64)
+    is_outer = np.zeros(max(N, 1), dtype=np.uint8)
+    bitmap = np.zeros((n_bits + 63) // 64, dtype=np.uint64)
+    tmp = np.empty(max(N, 1), dtype=np.int64)  # per-bucket cursors
+    n_pairs = ctypes.c_int64(0)
+    n_to = lib.hood_invert_and_pairs(
+        start, nbr_pos, N, E, owner, D,
+        to_start, to_src, is_outer, bitmap, ctypes.byref(n_pairs), tmp,
+    )
+    out_dev = np.zeros(max(n_pairs.value, 1), dtype=np.int64)
+    out_pos = np.zeros(max(n_pairs.value, 1), dtype=np.int64)
+    k = lib.extract_pairs(bitmap, D, max(N, 1), out_dev, out_pos)
+    assert k == n_pairs.value
+    pairs = np.stack([out_dev[:k], out_pos[:k]], axis=1)
+    return to_start, to_src[:n_to], pairs, is_outer.astype(bool)[:N]
+
+
+def native_fill_tables(
+    start, nbr_pos, offset3, slot, owner, row_of, len_all,
+    ghost_pos_lists, n_local, D, R, Kmax,
+    nbr_rows, nbr_valid, nbr_offset, nbr_len, nbr_slot,
+):
+    """Fused gather-table fill (C++): writes the five pre-allocated
+    (D, R, Kmax[, 3]) tables in one sweep.  Returns True, or False if the
+    native library is unavailable (caller uses the numpy path)."""
+    lib = _load()
+    if lib is None:
+        return False
+    N = len(start) - 1
+    E = int(start[-1])
+    ghost_start = np.zeros(D + 1, dtype=np.int64)
+    np.cumsum([len(g) for g in ghost_pos_lists], out=ghost_start[1:])
+    ghost_concat = (
+        np.ascontiguousarray(np.concatenate(ghost_pos_lists), dtype=np.int64)
+        if ghost_start[-1]
+        else np.zeros(1, dtype=np.int64)
+    )
+    lib.hood_fill_tables(
+        np.ascontiguousarray(start, dtype=np.int64),
+        np.ascontiguousarray(nbr_pos, dtype=np.int64),
+        np.ascontiguousarray(offset3, dtype=np.int64).reshape(-1),
+        np.ascontiguousarray(slot, dtype=np.int32),
+        N, E,
+        np.ascontiguousarray(owner, dtype=np.int64),
+        np.ascontiguousarray(row_of, dtype=np.int64),
+        np.ascontiguousarray(len_all, dtype=np.int64),
+        ghost_concat, ghost_start,
+        np.ascontiguousarray(n_local, dtype=np.int64),
+        int(D), int(R), int(Kmax),
+        nbr_rows.reshape(-1), nbr_valid.view(np.uint8).reshape(-1),
+        nbr_offset.reshape(-1), nbr_len.reshape(-1), nbr_slot.reshape(-1),
+    )
+    return True

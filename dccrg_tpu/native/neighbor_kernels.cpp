@@ -81,6 +81,14 @@ inline int64_t leaf_position(const uint64_t* leaves, int64_t n, uint64_t id) {
     return -1;
 }
 
+// uniform level-0 grid: the sorted unique leaf array is exactly [1..n],
+// so position(id) = id - 1 — no search
+inline int64_t leaf_position_any(const uint64_t* leaves, int64_t n,
+                                 uint64_t id, int uniform) {
+    if (uniform) return (id >= 1 && id <= uint64_t(n)) ? int64_t(id) - 1 : -1;
+    return leaf_position(leaves, n, id);
+}
+
 }  // namespace
 
 extern "C" {
@@ -96,6 +104,7 @@ int find_neighbors(
     const uint8_t* periodic,
     const int64_t* hood, int64_t n_hood,           // (K, 3) flattened
     const uint64_t* src_cells, int64_t n_src,
+    int uniform,                                   // leaves == [1..n0] level-0
     int strict,
     int emit,
     int64_t* counts,                               // n_src
@@ -146,7 +155,7 @@ int find_neighbors(
 
             // same level?
             uint64_t cand = cell_from_indices(m, t_mod, lvl);
-            int64_t pos = leaf_position(leaves, n_leaves, cand);
+            int64_t pos = leaf_position_any(leaves, n_leaves, cand, uniform);
             if (pos >= 0) {
                 n_entries += 1;
                 if (emit) {
@@ -162,7 +171,7 @@ int find_neighbors(
             // coarser?
             if (lvl > 0) {
                 uint64_t coarse = cell_from_indices(m, t_mod, lvl - 1);
-                int64_t cpos = leaf_position(leaves, n_leaves, coarse);
+                int64_t cpos = leaf_position_any(leaves, n_leaves, coarse, uniform);
                 if (cpos >= 0) {
                     n_entries += 1;
                     if (emit) {
@@ -194,7 +203,7 @@ int find_neighbors(
                             t_mod[2] + dz * half,
                         };
                         uint64_t child = cell_from_indices(m, ci, lvl + 1);
-                        int64_t ppos = leaf_position(leaves, n_leaves, child);
+                        int64_t ppos = leaf_position_any(leaves, n_leaves, child, uniform);
                         if (ppos < 0 && strict) {
 #pragma omp critical
                             { error = 1; *bad_cell = cell; *bad_slot = k; }
@@ -232,6 +241,162 @@ int64_t sort_unique_u64(uint64_t* keys, int64_t n) {
     std::sort(keys, keys + n);
 #endif
     return std::unique(keys, keys + n) - keys;
+}
+
+// Fused inverse-CSR + ghost-pair + inner/outer pass over the neighbor
+// lists — one cache-friendly sweep replacing ~8 full-E numpy passes
+// (invert_neighbors' packed-pair sort, the remote-edge masks, and the
+// ghost (device, position) dedupe in epoch.py's _build_hood).
+//
+// The inverse relation uses counting buckets instead of an E log E sort:
+// edges are emitted in ascending source order, so each target's bucket
+// receives its sources already sorted and duplicate (src, nbr) edges
+// (a coarse neighbor reached via several slots) are adjacent.
+//
+// Inputs: CSR (start, nbr_pos) over N sources with E edges; owner[N];
+// D devices.  Outputs (caller-allocated):
+//   to_start[N+1], to_src[E]   — unique inverse CSR (count returned)
+//   is_outer[N]                — local cell with any remote of/to edge
+//                                (caller-zeroed)
+//   pair_bitmap[ceil(D*N/64)]  — bit d*N+p set iff device d needs a ghost
+//                                of leaf p (caller-zeroed)
+//   n_pairs                    — number of set bits
+//   tmp[N]                     — scratch for the per-bucket write cursors
+// Single-threaded: every step is memory-bound scatter/gather.
+int64_t hood_invert_and_pairs(
+    const int64_t* start, const int64_t* nbr_pos,
+    int64_t N, int64_t E,
+    const int64_t* owner, int64_t D,
+    int64_t* to_start, int64_t* to_src,
+    uint8_t* is_outer,
+    uint64_t* pair_bitmap, int64_t* n_pairs,
+    int64_t* tmp
+) {
+    // pass 1: bucket counts + remote-edge side effects
+    for (int64_t p = 0; p <= N; p++) to_start[p] = 0;
+    int64_t pairs = 0;
+    for (int64_t i = 0; i < N; i++) {
+        const int64_t oi = owner[i];
+        for (int64_t e = start[i]; e < start[i + 1]; e++) {
+            const int64_t p = nbr_pos[e];
+            to_start[p + 1]++;
+            const int64_t op = owner[p];
+            if (op != oi) {
+                is_outer[i] = 1;
+                is_outer[p] = 1;
+                const uint64_t b1 = uint64_t(oi) * N + p;  // oi needs ghost p
+                const uint64_t b2 = uint64_t(op) * N + i;  // op needs ghost i
+                uint64_t w, m;
+                w = b1 >> 6; m = uint64_t(1) << (b1 & 63);
+                if (!(pair_bitmap[w] & m)) { pair_bitmap[w] |= m; pairs++; }
+                w = b2 >> 6; m = uint64_t(1) << (b2 & 63);
+                if (!(pair_bitmap[w] & m)) { pair_bitmap[w] |= m; pairs++; }
+            }
+        }
+    }
+    *n_pairs = pairs;
+    for (int64_t p = 0; p < N; p++) to_start[p + 1] += to_start[p];
+    // pass 2: scatter sources into buckets.  Sources arrive in ascending
+    // order per bucket (edges iterate src ascending), so duplicates are
+    // adjacent and dedupe is a last-element check.  Raw buckets are
+    // written into to_src at their un-deduped offsets; tmp[N] holds the
+    // per-bucket write cursors, initialized to the bucket starts.
+    std::memcpy(tmp, to_start, sizeof(int64_t) * N);
+    int64_t* cursor = tmp;
+    int64_t* raw = to_src;  // compacted in place below
+    for (int64_t i = 0; i < N; i++) {
+        for (int64_t e = start[i]; e < start[i + 1]; e++) {
+            const int64_t p = nbr_pos[e];
+            int64_t c = cursor[p];
+            if (c > to_start[p] && raw[c - 1] == i) continue;  // duplicate
+            raw[c] = i;
+            cursor[p] = c + 1;
+        }
+    }
+    // pass 3: compact buckets in place (ascending, so left-moves are safe)
+    int64_t w = 0;
+    int64_t prev_start = to_start[0];
+    for (int64_t p = 0; p < N; p++) {
+        const int64_t b0 = prev_start, b1 = cursor[p];
+        prev_start = to_start[p + 1];
+        to_start[p] = w;
+        for (int64_t c = b0; c < b1; c++) raw[w++] = raw[c];
+    }
+    to_start[N] = w;
+    return w;
+}
+
+// Extract the set bits of the ghost-pair bitmap in ascending (device,
+// position) order.  Returns the number written.
+int64_t extract_pairs(
+    const uint64_t* pair_bitmap, int64_t D, int64_t N,
+    int64_t* out_dev, int64_t* out_pos
+) {
+    const uint64_t total = uint64_t(D) * N;
+    const int64_t words = int64_t((total + 63) / 64);
+    int64_t k = 0;
+    for (int64_t wi = 0; wi < words; wi++) {
+        uint64_t w = pair_bitmap[wi];
+        while (w) {
+            const int b = __builtin_ctzll(w);
+            w &= w - 1;
+            const uint64_t bit = uint64_t(wi) * 64 + b;
+            out_dev[k] = int64_t(bit / N);
+            out_pos[k] = int64_t(bit % N);
+            k++;
+        }
+    }
+    return k;
+}
+
+// Fused gather-table fill: one sweep over the neighbor CSR writing the
+// five per-device tables (row, valid, offset, length, slot) that epoch.py's
+// _finish_hood builds with ~10 full-E numpy passes.  Ghost rows resolve by
+// binary search in the owner's sorted ghost list.
+// Tables are caller-allocated and pre-filled with their pad values.
+void hood_fill_tables(
+    const int64_t* start, const int64_t* nbr_pos,
+    const int64_t* offset3, const int32_t* slot,
+    int64_t N, int64_t E,
+    const int64_t* owner, const int64_t* row_of, const int64_t* len_all,
+    const int64_t* ghost_concat, const int64_t* ghost_start,  // D+1
+    const int64_t* n_local,
+    int64_t D, int64_t R, int64_t Kmax,
+    int32_t* nbr_rows, uint8_t* nbr_valid, int32_t* nbr_offset,
+    int32_t* nbr_len, int32_t* nbr_slot
+) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < N; i++) {
+        const int64_t d = owner[i];
+        const int64_t* gl = ghost_concat + ghost_start[d];
+        const int64_t gn = ghost_start[d + 1] - ghost_start[d];
+        int64_t base = (d * R + row_of[i]) * Kmax;
+        for (int64_t e = start[i]; e < start[i + 1]; e++) {
+            const int64_t k = e - start[i];
+            const int64_t p = nbr_pos[e];
+            int64_t row;
+            if (owner[p] == d) {
+                row = row_of[p];
+            } else {
+                int64_t lo = 0, hi = gn - 1;
+                row = R - 1;  // scratch if absent (cannot happen)
+                while (lo <= hi) {
+                    const int64_t mid = (lo + hi) >> 1;
+                    if (gl[mid] < p) lo = mid + 1;
+                    else if (gl[mid] > p) hi = mid - 1;
+                    else { row = n_local[d] + mid; break; }
+                }
+            }
+            const int64_t t = base + k;
+            nbr_rows[t] = int32_t(row);
+            nbr_valid[t] = 1;
+            nbr_offset[3 * t + 0] = int32_t(offset3[3 * e + 0]);
+            nbr_offset[3 * t + 1] = int32_t(offset3[3 * e + 1]);
+            nbr_offset[3 * t + 2] = int32_t(offset3[3 * e + 2]);
+            nbr_len[t] = int32_t(len_all[p]);
+            nbr_slot[t] = slot[e];
+        }
+    }
 }
 
 }  // extern "C"

@@ -101,13 +101,26 @@ def _build_hood(
     topology: Topology,
     leaves: LeafSet,
     offsets: np.ndarray,
+    n_devices: int,
 ):
     N = len(leaves)
     lists = find_all_neighbors(mapping, topology, leaves, offsets)
-    to_start, to_src = invert_neighbors(N, lists)
     owner = leaves.owner.astype(np.int64)
 
-    # --- ghost requirement: remote cells in neighbors_of/to of local cells
+    # Fused native pass: inverse CSR + ghost pairs + inner/outer in one
+    # cache-friendly sweep (counting buckets instead of an E log E sort)
+    from ..native import native_invert_and_pairs
+
+    native = native_invert_and_pairs(lists.start, lists.nbr_pos, owner,
+                                     n_devices)
+    if native is not None:
+        to_start, to_src, pairs, is_outer = native
+        return lists, to_start, to_src, pairs, is_outer
+
+    # --- numpy fallback (semantic source of truth)
+    to_start, to_src = invert_neighbors(N, lists)
+
+    # ghost requirement: remote cells in neighbors_of/to of local cells
     from ..utils.setops import unique_pairs
 
     src_of = np.repeat(np.arange(N), np.diff(lists.start))
@@ -122,7 +135,13 @@ def _build_hood(
         max(N, 1),
     )
     pairs = np.stack([dev_u, pos_u], axis=1)
-    return lists, to_start, to_src, pairs
+    # inner/outer: a remote edge (i -> j) makes i outer via neighbors_of
+    # and j outer via neighbors_to
+    is_outer = np.zeros(N, dtype=bool)
+    rem = np.flatnonzero(mask)
+    is_outer[src_of[rem]] = True
+    is_outer[lists.nbr_pos[rem]] = True
+    return lists, to_start, to_src, pairs, is_outer
 
 
 def build_epoch(
@@ -145,8 +164,10 @@ def build_epoch(
     hood_raw = {}
     all_pairs = []
     for hid, offsets in neighborhoods.items():
-        lists, to_start, to_src, pairs = _build_hood(mapping, topology, leaves, offsets)
-        hood_raw[hid] = (offsets, lists, to_start, to_src, pairs)
+        lists, to_start, to_src, pairs, is_outer = _build_hood(
+            mapping, topology, leaves, offsets, D
+        )
+        hood_raw[hid] = (offsets, lists, to_start, to_src, pairs, is_outer)
         all_pairs.append(pairs)
     if all_pairs:
         from ..utils.setops import unique_pairs
@@ -201,9 +222,12 @@ def build_epoch(
     )
 
     # --- pass 2: per-hood device tables + schedules
-    for hid, (offsets, lists, to_start, to_src, h_pairs) in hood_raw.items():
+    for hid, (offsets, lists, to_start, to_src, h_pairs, is_outer) in (
+        hood_raw.items()
+    ):
         epoch.hoods[hid] = _finish_hood(
-            epoch, offsets, lists, to_start, to_src, h_pairs, len_all
+            epoch, offsets, lists, to_start, to_src, h_pairs, len_all,
+            is_outer,
         )
     epoch.dense = detect_dense(mapping, topology, leaves, D)
     return epoch
@@ -217,6 +241,7 @@ def _finish_hood(
     to_src: np.ndarray,
     pairs: np.ndarray,
     len_all: np.ndarray,
+    is_outer: np.ndarray,
 ) -> HoodState:
     D, R, N = epoch.n_devices, epoch.R, len(epoch.leaves)
     owner = epoch.leaves.owner.astype(np.int64)
@@ -255,10 +280,7 @@ def _finish_hood(
                 rrow[m] = epoch.rows_on_device(d, gp[m])
         recv_rows[rd, sd, in_grp] = rrow
 
-    # --- neighbor gather tables over local rows (flat one-pass scatters).
-    # Every 26M-edge intermediate is computed once and reused: the same
-    # (source, neighbor) edge arrays feed the gather tables AND the
-    # inner/outer split below.
+    # --- neighbor gather tables over local rows
     counts = np.diff(lists.start)
     Kmax = int(counts.max()) if N else 1
     Kmax = max(Kmax, 1)
@@ -268,40 +290,44 @@ def _finish_hood(
     nbr_len = np.zeros((D, R, Kmax), dtype=np.int32)
     nbr_slot = np.zeros((D, R, Kmax), dtype=np.int32)
     E = int(lists.start[-1])
-    is_outer = np.zeros(N, dtype=bool)
     if E:
-        from ..utils.setops import ragged_arange
+        from ..native import native_fill_tables
 
-        esrc = np.repeat(np.arange(N), counts)
-        ecol = ragged_arange(counts)
-        # one N-sized precompute replaces two E-sized gathers + arithmetic
-        grow = owner * np.int64(R) + epoch.row_of.astype(np.int64)
-        flat = grow[esrc] * np.int64(Kmax) + ecol
-        if flat.size and D * R * Kmax < np.iinfo(np.int32).max:
-            flat = flat.astype(np.int32)  # halves scatter index traffic
-        # row of each neighbor on the source's device
-        edev = owner[esrc]
-        nrows = np.empty(E, dtype=np.int64)
-        local_e = owner[lists.nbr_pos] == edev
-        nrows[local_e] = epoch.row_of[lists.nbr_pos[local_e]]
-        rem = np.flatnonzero(~local_e)
-        for d in range(D):
-            sub = rem[edev[rem] == d]
-            if len(sub):
-                nrows[sub] = epoch.rows_on_device(d, lists.nbr_pos[sub])
-        nbr_rows.reshape(-1)[flat] = nrows
-        nbr_valid.reshape(-1)[flat] = True
-        nbr_offset.reshape(-1, 3)[flat] = lists.offset
-        nbr_len.reshape(-1)[flat] = len_all[lists.nbr_pos]
-        nbr_slot.reshape(-1)[flat] = lists.slot
+        filled = native_fill_tables(
+            lists.start, lists.nbr_pos, lists.offset, lists.slot,
+            owner, epoch.row_of, len_all, epoch.ghost_pos, epoch.n_local,
+            D, R, Kmax,
+            nbr_rows, nbr_valid, nbr_offset, nbr_len, nbr_slot,
+        )
+        if not filled:
+            # numpy fallback: flat one-pass scatters over the edge arrays
+            from ..utils.setops import ragged_arange
 
-        # --- inner/outer split (dccrg.hpp:7478-7519): outer = local cell
-        # with a remote cell among neighbors_of or neighbors_to.  A remote
-        # edge (i -> j, owners differ) makes i outer via neighbors_of and
-        # j outer via neighbors_to — the `rem` edge set already found
-        # above covers both directions, no to_start/to_src pass needed.
-        is_outer[esrc[rem]] = True
-        is_outer[lists.nbr_pos[rem]] = True
+            esrc = np.repeat(np.arange(N), counts)
+            ecol = ragged_arange(counts)
+            # one N-sized precompute replaces two E-sized gathers
+            grow = owner * np.int64(R) + epoch.row_of.astype(np.int64)
+            flat = grow[esrc] * np.int64(Kmax) + ecol
+            if flat.size and D * R * Kmax < np.iinfo(np.int32).max:
+                flat = flat.astype(np.int32)  # halves scatter index traffic
+            # row of each neighbor on the source's device
+            edev = owner[esrc]
+            nrows = np.empty(E, dtype=np.int64)
+            local_e = owner[lists.nbr_pos] == edev
+            nrows[local_e] = epoch.row_of[lists.nbr_pos[local_e]]
+            rem = np.flatnonzero(~local_e)
+            for d in range(D):
+                sub = rem[edev[rem] == d]
+                if len(sub):
+                    nrows[sub] = epoch.rows_on_device(d, lists.nbr_pos[sub])
+            nbr_rows.reshape(-1)[flat] = nrows
+            nbr_valid.reshape(-1)[flat] = True
+            nbr_offset.reshape(-1, 3)[flat] = lists.offset
+            nbr_len.reshape(-1)[flat] = len_all[lists.nbr_pos]
+            nbr_slot.reshape(-1)[flat] = lists.slot
+    # inner/outer split (dccrg.hpp:7478-7519): outer = local cell with a
+    # remote cell among neighbors_of or neighbors_to; computed alongside
+    # the ghost pairs in _build_hood
     inner_mask = np.zeros((D, R), dtype=bool)
     outer_mask = np.zeros((D, R), dtype=bool)
     for d in range(D):

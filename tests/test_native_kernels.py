@@ -57,6 +57,63 @@ def test_native_strict_error():
         find_all_neighbors(m, t, leaves, default_neighborhood(0))
 
 
+@pytest.mark.parametrize("periodic", [(True, False, True), (True, True, True)])
+def test_native_epoch_matches_numpy(periodic):
+    """The fused C++ epoch pass (hood_invert_and_pairs + hood_fill_tables
+    + uniform-grid position fast path) builds a bit-identical HoodState to
+    the pure-numpy reference path, on a refined multi-device grid."""
+    import os
+
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+
+    def build():
+        n = 12
+        g = (
+            Grid()
+            .set_initial_length((n, n, n))
+            .set_neighborhood_length(1)
+            .set_periodic(*periodic)
+            .set_maximum_refinement_level(1)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / n,) * 3,
+            )
+            .initialize(mesh=make_mesh(n_devices=4))
+        )
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        r = np.linalg.norm(c - 0.5, axis=1)
+        for cid in ids[r < 0.25]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        return g
+
+    import dccrg_tpu.native as native_mod
+
+    g_nat = build()
+    os.environ["DCCRG_TPU_NATIVE"] = "0"
+    try:
+        native_mod._tried, native_mod._lib = True, None
+        g_ref = build()
+    finally:
+        del os.environ["DCCRG_TPU_NATIVE"]
+        native_mod._tried = False
+
+    h_nat = g_nat.epoch.hoods[None]
+    h_ref = g_ref.epoch.hoods[None]
+    for f in (
+        "to_start", "to_src", "send_rows", "recv_rows", "pair_counts",
+        "inner_mask", "outer_mask", "nbr_rows", "nbr_valid", "nbr_offset",
+        "nbr_len", "nbr_slot",
+    ):
+        np.testing.assert_array_equal(
+            getattr(h_nat, f), getattr(h_ref, f), err_msg=f
+        )
+
+
 def test_native_sort_unique_matches_numpy():
     from dccrg_tpu.native import native_available, native_sort_unique_u64
 
