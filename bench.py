@@ -349,48 +349,70 @@ def measure_cpu_baseline() -> float:
     return value
 
 
-def _accelerator_reachable(timeout_s: int = 180) -> bool:
-    """Probe device init in a subprocess: the axon TPU tunnel, when down,
-    hangs jax.devices() indefinitely — which would leave the driver with
-    no bench line at all.  A CPU fallback result (clearly labeled) beats a
-    hung process.  The probe requires an actual TPU platform: a fast
-    tunnel failure can make JAX silently fall back to CPU with exit code
-    0, which must not let measure_tpu() publish a CPU number under the
-    TPU headline.  Cost on a healthy chip is one throwaway runtime init
-    (~20 s) — accepted insurance for a once-per-round bench."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM:' + jax.devices()[0].platform)"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        return r.returncode == 0 and "PLATFORM:tpu" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+#: wall-clock ceiling for the real measurement child process; the full
+#: bench (compiles + runs + CPU baseline) takes ~10-20 min through the
+#: tunnel on a healthy chip
+_REAL_BENCH_TIMEOUT_S = int(os.environ.get("DCCRG_BENCH_TIMEOUT", 2700))
 
 
 def main():
-    if not _accelerator_reachable():
-        print(
-            "accelerator unreachable (tunnel down?); "
-            "falling back to the 8-device virtual CPU mesh measurement",
-            file=sys.stderr,
-        )
-        r = measure_multidev_cpu()
-        print(json.dumps({
-            "metric": "3d_advection_cell_updates_per_sec_per_chip",
-            "value": -1.0,
-            "unit": "cell-updates/s/chip",
-            "vs_baseline": -1.0,
-            "detail": {
-                "error": "TPU tunnel unreachable at bench time; "
-                         "no accelerator number could be produced",
-                "multidev_cpu": r,
-            },
-        }))
+    """Run the real measurement in a child process under a hard timeout.
+
+    The axon TPU tunnel, when down, hangs jax device init indefinitely —
+    which would leave the driver with no bench line at all.  Running the
+    measurement itself under the timeout (rather than an advisory probe
+    first) closes the window where the tunnel drops between a probe and
+    the measurement.  On failure or timeout the bench emits a clearly
+    labeled error record with captured diagnostics and the virtual-CPU
+    -mesh correctness evidence instead of hanging."""
+    if "--_real" in sys.argv:
+        _main_real()
         return
+    diag = {}
+    try:
+        r = subprocess.run(
+            [sys.executable, str(pathlib.Path(__file__).resolve()), "--_real"],
+            timeout=_REAL_BENCH_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+        line = next(
+            (ln for ln in reversed(r.stdout.splitlines())
+             if ln.startswith("{")),
+            None,
+        )
+        if r.returncode == 0 and line:
+            sys.stderr.write(r.stderr)
+            print(line)
+            return
+        diag = {"rc": r.returncode, "stderr_tail": r.stderr[-800:]}
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        diag = {"timeout_s": _REAL_BENCH_TIMEOUT_S, "stderr_tail": err[-800:]}
+    print(
+        f"accelerator measurement failed ({diag}); "
+        "falling back to the 8-device virtual CPU mesh measurement",
+        file=sys.stderr,
+    )
+    r8 = measure_multidev_cpu()
+    print(json.dumps({
+        "metric": "3d_advection_cell_updates_per_sec_per_chip",
+        "value": -1.0,
+        "unit": "cell-updates/s/chip",
+        "vs_baseline": -1.0,
+        "detail": {
+            "error": "accelerator measurement failed or timed out "
+                     "(tunnel down, broken runtime, or bench crash); "
+                     "no accelerator number could be produced",
+            "diagnostics": diag,
+            "multidev_cpu": r8,
+        },
+    }))
+
+
+def _main_real():
     tpu = measure_tpu()
     extras = {}
     for name, fn in (("refined", measure_refined), ("large", measure_large),
