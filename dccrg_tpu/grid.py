@@ -485,11 +485,20 @@ class Grid:
         ``remap_state`` (pure ownership moves keep every cell's value)."""
         self._assert_initialized()
         from .parallel.loadbalance import compute_partition
+        from .utils.collectives import sync_partition_inputs
+
+        # multi-controller agreement on pins/weights before partitioning
+        # (update_pin_requests All_Gather, dccrg.hpp:8297-8340) — a
+        # transient merged view; this controller's own dicts stay local.
+        # Identity under the single controller.
+        all_pins, all_weights = sync_partition_inputs(
+            self.pin_requests, self.cell_weights
+        )
 
         weights = None
-        if self.cell_weights:
+        if all_weights:
             weights = np.ones(len(self.leaves))
-            for c, w in self.cell_weights.items():
+            for c, w in all_weights.items():
                 p = int(self.leaves.position(np.uint64(c)))
                 if p >= 0:
                     weights[p] = w
@@ -506,7 +515,7 @@ class Grid:
 
         # pins override the partitioner (make_new_partition,
         # dccrg.hpp:8417-8580)
-        for c, d in self.pin_requests.items():
+        for c, d in all_pins.items():
             p = int(self.leaves.position(np.uint64(c)))
             if p >= 0:
                 owner[p] = d

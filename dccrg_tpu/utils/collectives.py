@@ -32,6 +32,7 @@ __all__ = [
     "allgather_u64_multi",
     "union_u64",
     "sync_adaptation",
+    "sync_partition_inputs",
     "all_gather",
     "all_reduce",
     "some_reduce",
@@ -124,6 +125,42 @@ def sync_adaptation(queues) -> None:
     for i, name in enumerate(names):
         merged = np.unique(np.concatenate([row[i] for row in rows]))
         setattr(queues, name, {int(c) for c in merged})
+
+
+def sync_partition_inputs(pin_requests: dict, cell_weights: dict) -> tuple:
+    """The merged (pins, weights) view across every controller — the
+    agreement step before ``balance_load`` partitions, mirroring the
+    reference's ``update_pin_requests`` All_Gather of per-rank pins
+    (``dccrg.hpp:8297-8340``) and its replicated cell-weight map.
+
+    Returns a TRANSIENT merged pair; the caller's own dicts stay local
+    (the reference likewise gathers into ``all_pin_requests`` while each
+    rank's ``pin_requests`` remains its own), so a later local unpin or
+    re-pin is not resurrected by stale copies inherited from peers.
+
+    Both dicts travel as (cell-id array, value array) pairs in the one
+    lengths+padded-payload wire format (weights bitcast to uint64).
+    Merge order is process rank: when two controllers disagree about the
+    same cell, the highest rank's entry wins — deterministic, and every
+    process applies the identical rule.  Identity with one controller."""
+    if process_count() == 1:
+        return pin_requests, cell_weights
+    pin_cells = np.fromiter(pin_requests.keys(), dtype=np.uint64,
+                            count=len(pin_requests))
+    pin_devs = np.fromiter(pin_requests.values(), dtype=np.uint64,
+                           count=len(pin_requests))
+    w_cells = np.fromiter(cell_weights.keys(), dtype=np.uint64,
+                          count=len(cell_weights))
+    w_vals = np.fromiter(cell_weights.values(), dtype=np.float64,
+                         count=len(cell_weights)).view(np.uint64)
+    rows = allgather_u64_multi([pin_cells, pin_devs, w_cells, w_vals])
+    merged_pins, merged_weights = {}, {}
+    for row in rows:                       # ascending process rank
+        for c, d in zip(row[0], row[1]):
+            merged_pins[int(c)] = int(d)
+        for c, w in zip(row[2], row[3].view(np.float64)):
+            merged_weights[int(c)] = float(w)
+    return merged_pins, merged_weights
 
 
 def all_gather(per_device_values) -> list:

@@ -111,3 +111,32 @@ def test_sync_adaptation_identity_single_process():
     before = set(g.amr.to_refine)
     sync_adaptation(g.amr)
     assert g.amr.to_refine == before
+
+
+def test_balance_load_merges_remote_pins_and_weights(monkeypatch):
+    """A pin and a weight registered by a (mocked) remote controller are
+    honored by the local balance_load — partition inputs reach agreement
+    before the partitioner runs (update_pin_requests, dccrg.hpp:8297-8340)."""
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 1))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh(n_devices=2))
+    )
+    g.pin(3, 0)                            # local pin: cell 3 -> device 0
+    # remote controller pinned cell 7 -> device 1 and weighted cell 5 by 9.0
+    w = np.asarray(9.0, dtype=np.float64).view(np.uint64)
+    peer = [
+        np.array([1, 1, 1, 1], dtype=np.int64),   # pins(2 arrays), weights(2)
+        np.array([7, 1, 5, int(w)], dtype=np.uint64),
+    ]
+    _FakeTransport(monkeypatch, [peer])
+    g.balance_load()
+    assert g.get_owner([3])[0] == 0
+    assert g.get_owner([7])[0] == 1
+    # the merged view is transient: this controller's own dicts stay
+    # local, so a later unpin here cannot be resurrected by stale copies
+    # inherited from peers (reference: all_pin_requests is a gather-side
+    # temporary, dccrg.hpp:8297-8340)
+    assert g.pin_requests == {3: 0}
+    assert g.cell_weights == {}
