@@ -67,6 +67,7 @@ class Advection:
             self.boxed = build_boxed(grid, hood_id)
             if self.boxed is not None:
                 self._boxed_run = self._build_boxed_run(self.boxed)
+                self._flat_run = self._build_flat_run()
 
     # ------------------------------------------------------ static tables
 
@@ -216,6 +217,62 @@ class Advection:
         return max_diff
 
     # ------------------------------------------------------ boxed AMR path
+
+    def _build_flat_run(self):
+        """Whole-run fused kernel for two-level AMR on the flat inflated
+        grid (ops/flat_amr.py): the entire run loop in VMEM, one launch.
+        None when the grid/device/dtype does not qualify; the boxed path
+        remains the general fallback (and the step()/indicator path)."""
+        from ..ops.dense_advection import pallas_available
+        from ..ops.flat_amr import (
+            build_flat_amr_tables,
+            compute_flat_weights,
+            make_flat_amr_run,
+        )
+
+        interpret = self.use_pallas == "interpret"
+        if not self.use_pallas:
+            return None
+        if np.dtype(self.dtype) != np.float32:
+            return None
+        if not (interpret or pallas_available(self.dtype)):
+            return None
+        t = build_flat_amr_tables(self.grid)
+        if t is None:
+            return None
+        nz1, ny1, nx1 = t["shape"]
+        kernel = make_flat_amr_run(nz1, ny1, nx1, interpret=interpret)
+        rows = jnp.asarray(t["rows"])
+        leaf = t["leaf_fine"]
+        updf = jnp.asarray(leaf.astype(np.float64) / t["vol_f"], jnp.float32)
+        updc = jnp.asarray((~leaf).astype(np.float64) / t["vol_c"], jnp.float32)
+        wb_rows = jnp.asarray(t["wb_rows"])
+        wb_valid = jnp.asarray(t["wb_valid"])
+
+        @jax.jit
+        def run_fn(state, steps, dt):
+            def field(name):
+                return state[name][0][rows].reshape(nz1, ny1, nx1)
+
+            V = field("density")
+            w = compute_flat_weights(
+                t, field("vx"), field("vy"), field("vz")
+            )
+            (wpx, wnx), (wpy, wny), (wpz, wnz) = w
+            out = kernel(
+                V, wpx, wnx, wpy, wny, wpz, wnz, updf, updc,
+                jnp.asarray(dt, jnp.float32), steps,
+            )
+            rho = jnp.where(
+                wb_valid, out.reshape(-1)[wb_rows], state["density"][0]
+            )
+            return {
+                **state,
+                "density": rho[None].astype(state["density"].dtype),
+                "flux": jnp.zeros_like(state["flux"]),
+            }
+
+        return run_fn
 
     def _build_boxed_run(self, layout):
         """Multi-step run over the boxed per-level AMR layout — one unified
@@ -623,6 +680,10 @@ class Advection:
         interleaved with host logic (AMR, load balancing, IO)."""
         if getattr(self, "_fused_run", None) is not None:
             return self._fused_run(
+                state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
+            )
+        if getattr(self, "_flat_run", None) is not None:
+            return self._flat_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if getattr(self, "_boxed_run", None) is not None:

@@ -1,0 +1,282 @@
+"""Whole-run fused kernel for two-level AMR advection on a flat inflated
+grid — the VMEM-resident counterpart of the boxed per-level path
+(``models/boxed_advection.py``).
+
+Scheme: replicate every level-0 (coarse) leaf onto its 2x2x2 block of
+level-1 voxels, giving ONE dense array ``V`` at level-1 resolution over
+the whole domain.  Every face the reference prices (``solve.hpp:129-260``
+semantics) then appears as voxel pairs of ``V``:
+
+* fine-fine faces — one voxel pair, face velocity = plain average;
+* coarse-fine faces — one voxel pair per fine sub-face (exactly how the
+  reference iterates the 4 finer neighbors across a coarse face), face
+  velocity = the 2:1 length-weighted mix ``(2 v_fine + v_coarse)/3``
+  (``solve.hpp:168-175`` with ``nl == 2 cl``);
+* coarse-coarse faces — 4 voxel pairs carrying identical replicated
+  values and velocities, each weighted by a quarter of the coarse face
+  area (which equals the fine face area), so their sum reproduces the
+  single coarse flux exactly;
+* intra-block pairs (inside one replicated coarse cell) — weight 0.
+
+Because the upwind side is fixed by the (loop-invariant) face velocity,
+the flux needs no select at all: with ``w+ = w·[v_face >= 0]`` and
+``w- = w·[v_face < 0]`` precomputed per voxel face,
+``F = V·w+ + roll(V,-1)·w-``.  The coarse update is a roll-chain 2x2x2
+block sum (pool) masked to block origins, then a roll-chain broadcast
+back over the block — all of it rolls/multiplies/adds, the same op set
+as the uniform whole-block kernel (``dense_advection.make_fused_run``),
+so the entire multi-step AMR run executes in one kernel launch with
+every array resident in VMEM and zero HBM traffic between steps.
+
+Periodic boundaries are the rolls themselves (the array covers the whole
+domain); non-periodic wrap faces get weight 0.  Single device,
+levels ⊆ {0, 1}, f32.  Compute cost is ~(inflation factor) more
+voxel-updates than true leaves — the price of losing every gather,
+concat, and kernel-launch boundary of the boxed path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dense_advection import _make_rolls, pallas_available
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+__all__ = ["build_flat_amr_tables", "make_flat_amr_run", "flat_amr_fits"]
+
+#: VMEM cap: ~18 resident arrays (ping/pong state, 6 weights, 2 update
+#: masks, temporaries) — see make_fused_run's budget reasoning
+_FLAT_VMEM_BUDGET = 96 * 1024 * 1024
+_FLAT_ARRAYS = 18
+
+
+def flat_amr_fits(n_voxels: int) -> bool:
+    return _FLAT_ARRAYS * n_voxels * 4 <= _FLAT_VMEM_BUDGET
+
+
+def build_flat_amr_tables(grid):
+    """Static tables for the flat layout, or None if the grid does not
+    qualify (single device, Cartesian, leaves at levels {0, 1} with some
+    refinement, VMEM fit).
+
+    Returns a dict:
+      shape        (nz1, ny1, nx1) voxel grid at level-1 resolution
+      rows         (n_vox,) int32 epoch row per voxel (coarse replicated)
+      leaf_fine    (nz1, ny1, nx1) bool — voxel is a level-1 leaf
+      wb_rows      (R,) int32 — for each epoch row, a representative flat
+                   voxel (fine: its voxel; coarse: block origin); scratch
+                   and invalid rows point at voxel 0
+      wb_valid     (R,) bool
+      area_f, vol_f, vol_c, periodic
+    """
+    from ..geometry.cartesian import CartesianGeometry
+    from ..geometry.stretched import StretchedCartesianGeometry
+
+    epoch = grid.epoch
+    if epoch.n_devices != 1:
+        return None
+    if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
+        grid.geometry, StretchedCartesianGeometry
+    ):
+        return None
+    mapping = epoch.mapping
+    leaves = epoch.leaves
+    N = len(leaves)
+    if N == 0:
+        return None
+    lvl = mapping.get_refinement_level(leaves.cells).astype(np.int64)
+    if lvl.max() != 1 or lvl.min() != 0:
+        return None  # dense path (uniform) or deeper hierarchy (boxed)
+    L = mapping.max_refinement_level
+    nx1, ny1, nz1 = (int(v) << 1 for v in mapping.length)
+    n_vox = nx1 * ny1 * nz1
+    if not flat_amr_fits(n_vox):
+        return None
+
+    idx = mapping.get_indices(leaves.cells).astype(np.int64)  # (N,3) x,y,z
+    vox = idx >> (L - 1)                       # level-1-resolution origin
+    flat0 = (vox[:, 2] * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
+
+    rows = np.zeros(n_vox, dtype=np.int32)
+    leaf_fine = np.zeros(n_vox, dtype=bool)
+    fine = lvl == 1
+    rows[flat0[fine]] = epoch.row_of[fine]
+    leaf_fine[flat0[fine]] = True
+    coarse = np.flatnonzero(~fine)
+    for dz in range(2):
+        for dy in range(2):
+            for dx in range(2):
+                off = (dz * ny1 + dy) * nx1 + dx
+                rows[flat0[coarse] + off] = epoch.row_of[coarse]
+
+    R = epoch.R
+    wb_rows = np.zeros(R, dtype=np.int32)
+    wb_valid = np.zeros(R, dtype=bool)
+    wb_rows[epoch.row_of] = flat0
+    wb_valid[epoch.row_of] = True
+
+    l1 = np.asarray(grid.geometry.get_level_0_cell_length(), np.float64) / 2.0
+    return dict(
+        shape=(nz1, ny1, nx1),
+        rows=rows,
+        leaf_fine=leaf_fine.reshape(nz1, ny1, nx1),
+        wb_rows=wb_rows,
+        wb_valid=wb_valid,
+        area_f=np.array([l1[1] * l1[2], l1[0] * l1[2], l1[0] * l1[1]]),
+        vol_f=float(l1.prod()),
+        vol_c=float(l1.prod() * 8.0),
+        periodic=tuple(bool(grid.topology.is_periodic(d)) for d in range(3)),
+    )
+
+
+def compute_flat_weights(tables, VX, VY, VZ, dtype=jnp.float32):
+    """Per-voxel-face upwind weights (jittable; velocities are run inputs
+    but loop-invariant, so this runs once per run call).
+
+    For each axis d the face above voxel p pairs (p, p+e_d).  Returns
+    ``(wp, wn)`` per axis with ``F = V*wp + roll(V,-1,ax)*wn`` the signed
+    outgoing flux (no dt; the kernel multiplies dt into the update)."""
+    nz1, ny1, nx1 = tables["shape"]
+    leaf = jnp.asarray(tables["leaf_fine"])
+    area = tables["area_f"]
+    periodic = tables["periodic"]
+    vels = (VX, VY, VZ)
+    out = []
+    for d in range(3):
+        ax = 2 - d
+        n = (nx1, ny1, nz1)[d]
+        v = vels[d].astype(dtype)
+        vl, vh = v, jnp.roll(v, -1, ax)
+        fl, fh = leaf, jnp.roll(leaf, -1, ax)
+        third = dtype(1.0 / 3.0)
+        vface = jnp.where(
+            fl == fh,
+            dtype(0.5) * (vl + vh),           # same-kind: plain average
+            jnp.where(
+                fl,                            # fine below, coarse above
+                (dtype(2.0) * vl + vh) * third,
+                (vl + dtype(2.0) * vh) * third,
+            ),
+        )
+        # validity: intra-block coarse pairs carry no face; the wrap face
+        # (n-1 -> 0) exists only on periodic axes
+        pos = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), ax)
+        intra = (~fl) & (~fh) & (pos % 2 == 0)
+        valid = ~intra
+        if not periodic[d]:
+            valid = valid & (pos != n - 1)
+        w = jnp.where(valid, vface * dtype(area[d]), dtype(0.0))
+        wp = jnp.where(vface >= 0, w, dtype(0.0))
+        out.append((wp, w - wp))
+    return out
+
+
+def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
+                      interpret: bool = False):
+    """Returns ``run(V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c, dt,
+    steps) -> V'`` advancing the flat two-level grid ``steps`` timesteps
+    in one kernel launch (ping-pong scratch, runtime step count — the
+    same shell as ``make_fused_run``).
+
+    ``upd_f = leaf_fine/vol_f`` and ``upd_c = (~leaf_fine)/vol_c`` fold
+    the level-dependent volume division into per-voxel constants."""
+    roll_m1, roll_p1 = _make_rolls(interpret)
+
+    def kernel(dt_ref, steps_ref, v_ref, wpx, wnx, wpy, wny, wpz, wnz,
+               updf_ref, updc_ref, out_ref, scr_ref):
+        dt = dt_ref[0]
+        steps = steps_ref[0]
+        cwpx, cwnx = wpx[...], wnx[...]
+        cwpy, cwny = wpy[...], wny[...]
+        cwpz, cwnz = wpz[...], wnz[...]
+        updf, updc = updf_ref[...], updc_ref[...]
+        # pool mask = coarse voxels; fold it into updc's support: the
+        # roll-chain pool below must only sum coarse deltas, so mask with
+        # (updc != 0) — exact since updc is 0 or 1/vol_c
+        pool = (updc != 0).astype(cwpx.dtype)
+
+        def one_step(src_ref, dst_ref):
+            v = src_ref[...]
+            fx = v * cwpx + roll_m1(v, 2) * cwnx
+            fy = v * cwpy + roll_m1(v, 1) * cwny
+            fz = v * cwpz + roll_m1(v, 0) * cwnz
+            delta = roll_p1(fx, 2) - fx
+            delta = delta + roll_p1(fy, 1) - fy
+            delta = delta + roll_p1(fz, 0) - fz
+            # 2x2x2 block sum of coarse deltas at block origins: blocks
+            # are even-aligned, so the -1-roll chain puts sum_{e in
+            # {0,1}^3} s[p+e] at p, correct exactly at origins
+            s = delta * pool
+            s = s + roll_m1(s, 2)
+            s = s + roll_m1(s, 1)
+            s = s + roll_m1(s, 0)
+            # keep origins only (origin = even position on every axis AND
+            # coarse: updc masks fine leaves later; zero odd positions)
+            s = s * orig
+            # broadcast origin values over their blocks: non-origin
+            # positions hold 0, so b += roll(+1) duplicates along each
+            # axis without selects
+            s = s + roll_p1(s, 2)
+            s = s + roll_p1(s, 1)
+            s = s + roll_p1(s, 0)
+            dst_ref[...] = v + dt * (delta * updf + s * updc)
+
+        # origin parity mask, built once from iota (static shapes)
+        ex = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 2) % 2 == 0
+        ey = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 1) % 2 == 0
+        ez = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 0) % 2 == 0
+        orig = (ex & ey & ez).astype(cwpx.dtype)
+
+        out_ref[...] = v_ref[...]
+
+        def body(i, _):
+            even = (i % 2) == 0
+
+            @pl.when(even)
+            def _():
+                one_step(out_ref, scr_ref)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                one_step(scr_ref, out_ref)
+
+            return 0
+
+        jax.lax.fori_loop(0, steps, body, 0)
+
+        @pl.when((steps % 2) == 1)
+        def _():
+            out_ref[...] = scr_ref[...]
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_FLAT_VMEM_BUDGET
+        )
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[smem, smem] + [vmem] * 9,
+        out_specs=vmem,
+        scratch_shapes=[pltpu.VMEM((nz1, ny1, nx1), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((nz1, ny1, nx1), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+    def run(V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c, dt, steps):
+        dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
+        steps_arr = jnp.asarray(steps, jnp.int32).reshape(1)
+        return call(dt_arr, steps_arr, V, wpx, wnx, wpy, wny, wpz, wnz,
+                    upd_f, upd_c)
+
+    return run
