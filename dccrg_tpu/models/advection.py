@@ -683,9 +683,20 @@ class Advection:
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if getattr(self, "_flat_run", None) is not None:
-            return self._flat_run(
-                state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
-            )
+            try:
+                return self._flat_run(
+                    state, jnp.asarray(steps, jnp.int32),
+                    jnp.asarray(dt, self.dtype),
+                )
+            except Exception as e:  # noqa: BLE001 - Mosaic compile rejection
+                # the flat kernel is an optimization; if the TPU compiler
+                # rejects it (op support varies by generation), fall back
+                # to the boxed path permanently for this model instance
+                import sys
+
+                print(f"flat AMR kernel disabled ({e!r:.200}); "
+                      "using the boxed path", file=sys.stderr)
+                self._flat_run = None
         if getattr(self, "_boxed_run", None) is not None:
             return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
