@@ -219,6 +219,89 @@ def measure_large() -> dict:
     }
 
 
+def measure_poisson() -> dict:
+    """BASELINE.md config 3: iterative Poisson solve on a refined grid —
+    reports solver cell-iterations/s (matrix-free BiCG sweeps are the
+    reference's hot loop, tests/poisson/poisson_solve.hpp)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Poisson
+
+    n = 32
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    r = np.linalg.norm(c - 0.5, axis=1)
+    for cid in ids[r < 0.25]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+    rhs -= rhs.mean()
+
+    p = Poisson(g, dtype=np.float32)  # f32: the TPU-native precision
+    state = p.initialize_state(rhs)
+    iters = 60
+    # warmup/compile
+    jax.block_until_ready(p.solve(state, max_iterations=2,
+                                  stop_residual=0.0)[0]["solution"])
+
+    def one():
+        out, _res, _it = p.solve(state, max_iterations=iters,
+                                 stop_residual=0.0)
+        return out["solution"]
+
+    secs, times, _ = _median_of(one, n=3)
+    n_cells = len(ids)
+    return {
+        "n_cells": n_cells,
+        "iterations": iters,
+        "cell_iterations_per_s": n_cells * iters / secs,
+        "times_s": [round(t, 4) for t in times],
+    }
+
+
+def measure_vlasov() -> dict:
+    """BASELINE.md config 5 (Vlasiator stretch): 6-D Vlasov — a velocity
+    block per spatial cell; reports phase-space cell-updates/s."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu.models import Vlasov
+
+    g = _uniform_grid((32, 32, 32))
+    nv = 8
+    v = Vlasov(g, nv=nv, dtype=np.float32)
+    state = v.initialize_state()
+    dt = np.float32(0.4 * v.max_time_step())
+    steps = 50
+    jax.block_until_ready(v.run(state, 2, dt)["f"])
+    secs, times, _ = _median_of(lambda: v.run(state, steps, dt)["f"], n=3)
+    n_phase = 32 ** 3 * nv ** 3
+    return {
+        "n_spatial": 32 ** 3,
+        "nv": nv,
+        "phase_space_cells": n_phase,
+        "phase_updates_per_s": n_phase * steps / secs,
+        "times_s": [round(t, 4) for t in times],
+    }
+
+
 def measure_multidev_cpu() -> dict | None:
     """8-device virtual CPU mesh (subprocess): plumbing/correctness
     evidence (device-count-invariant checksum) plus the split-phase
@@ -416,6 +499,7 @@ def _main_real():
     tpu = measure_tpu()
     extras = {}
     for name, fn in (("refined", measure_refined), ("large", measure_large),
+                     ("poisson", measure_poisson), ("vlasov", measure_vlasov),
                      ("multidev_cpu", measure_multidev_cpu)):
         try:
             extras[name] = fn()
@@ -478,6 +562,12 @@ def _main_real():
             "hbm_peak_GBps": lg.get("hbm_peak_GBps"),
             "hbm_fraction_of_peak": lg.get("hbm_fraction_of_peak"),
         }
+    for name in ("poisson", "vlasov"):
+        if extras.get(name):
+            detail[name] = {
+                k: (round(v, 1) if isinstance(v, float) else v)
+                for k, v in extras[name].items()
+            }
     if extras.get("multidev_cpu"):
         detail["multidev_cpu"] = {
             k: (round(v, 6) if isinstance(v, float) else v)
