@@ -18,13 +18,12 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
 def run_sweep(workload: str, counts, size: int, turns: int):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={max(counts)}"
-        ).strip()
+    # env vars do not reliably override a tunneled TPU platform; force the
+    # virtual CPU mesh via jax.config exactly like tests/conftest.py
     import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", max(counts))
     import numpy as np
 
     from dccrg_tpu import CartesianGeometry, Grid, make_mesh
