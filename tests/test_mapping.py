@@ -223,3 +223,27 @@ def test_topology_roundtrip():
     assert t.is_periodic(0) and not t.is_periodic(1) and t.is_periodic(2)
     t2 = Topology.from_file_bytes(t.to_file_bytes())
     assert t2 == t
+
+
+def test_random_roundtrip_high_refinement_levels():
+    """Property test at refinement depths where exhaustive enumeration is
+    impossible (level-12 blocks hold ~7e13 ids): random ids round-trip
+    through (level, indices) and the parent/child tree stays consistent."""
+    m = Mapping(length=(5, 3, 7), max_refinement_level=12)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, int(m.last_cell) + 1, size=20000, dtype=np.uint64)
+    lvl = m.get_refinement_level(ids)
+    assert (lvl >= 0).all() and (lvl <= 12).all()
+    back = m.get_cell_from_indices(m.get_indices(ids), lvl)
+    np.testing.assert_array_equal(back, ids)
+
+    refined = ids[lvl > 0]
+    parents = m.get_parent(refined)
+    assert (m.get_refinement_level(parents) == m.get_refinement_level(refined) - 1).all()
+    kids = m.get_all_children(parents)          # (n, 8)
+    assert (kids == refined[:, None]).any(axis=1).all()
+    # children sit inside the parent's index volume
+    pidx = m.get_indices(parents)
+    cidx = m.get_indices(refined)
+    plen = m.get_cell_length_in_indices(parents)
+    assert ((cidx >= pidx) & (cidx < pidx + plen[:, None])).all()
