@@ -125,7 +125,7 @@ def bench_checkpoint(length: int):
     import tempfile
 
     from dccrg_tpu import Grid, make_mesh
-    from dccrg_tpu.io.checkpoint import load_grid_data, save_grid_data
+    from dccrg_tpu.io.checkpoint import save_grid_data
 
     g = (
         Grid()

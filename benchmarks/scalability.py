@@ -9,7 +9,6 @@ Usage: python benchmarks/scalability.py [gol|advection] [--devices 1 2 4 8]
 """
 import argparse
 import json
-import os
 import pathlib
 import sys
 import time

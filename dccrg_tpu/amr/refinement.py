@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..core.mapping import ERROR_CELL, Mapping
+from ..core.mapping import Mapping
 from ..core.neighbors import LeafSet, find_all_neighbors
 from ..utils.setops import csr_take, unique_u64
 

@@ -20,7 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .core.mapping import ERROR_CELL, Mapping
+from .core.mapping import Mapping
 from .core.topology import Topology
 from .core.neighborhood import default_neighborhood, validate_neighborhood
 from .core.neighbors import LeafSet

@@ -13,8 +13,6 @@ parity reference in tests.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

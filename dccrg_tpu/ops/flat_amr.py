@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .dense_advection import _make_rolls, pallas_available
+from .dense_advection import _make_rolls
 
 try:
     from jax.experimental import pallas as pl

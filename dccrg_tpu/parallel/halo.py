@@ -15,8 +15,6 @@ array values with no arithmetic.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
