@@ -27,6 +27,15 @@ from ..parallel.stencil import StencilTables, compact_rows, gather_neighbors
 __all__ = ["GameOfLife"]
 
 
+def _life_rule(count, alive):
+    """The 2/3 rule (examples/simple_game_of_life.cpp:95-106)."""
+    return jnp.where(
+        count == 3,
+        jnp.uint32(1),
+        jnp.where(count != 2, jnp.uint32(0), alive),
+    )
+
+
 class GameOfLife:
     #: the payload declaration — the reference's ``game_of_life_cell`` with
     #: its ``get_mpi_datatype`` seam (examples/simple_game_of_life.cpp:20-32)
@@ -35,7 +44,8 @@ class GameOfLife:
         "live_neighbor_count": ((), np.uint32),
     }
 
-    def __init__(self, grid, hood_id=None, overlap: bool = False):
+    def __init__(self, grid, hood_id=None, overlap: bool = False,
+                 allow_dense: bool = True):
         self.grid = grid
         self.hood_id = hood_id
         self._exchange = grid.halo(hood_id)
@@ -47,6 +57,17 @@ class GameOfLife:
         else:
             self.tables = StencilTables(grid, hood_id)
             self._step = self._build_step()
+        # overlap=True exists to exercise/measure the split-phase step, so
+        # it keeps the per-step loop
+        from ..parallel.dense import detect_dense2d
+
+        self.dense2d = (
+            detect_dense2d(grid, hood_id) if allow_dense and not overlap
+            else None
+        )
+        self._dense_run = (
+            self._build_dense_run() if self.dense2d is not None else None
+        )
 
     def new_state(self, alive_cells=()):
         state = self.grid.new_state(self.SPEC)
@@ -72,11 +93,7 @@ class GameOfLife:
                 jnp.where(tables["nbr_valid"], (nbr_alive > 0).astype(jnp.uint32), 0),
                 axis=-1,
             )
-            new_alive = jnp.where(
-                count == 3,
-                jnp.uint32(1),
-                jnp.where(count != 2, jnp.uint32(0), alive),
-            )
+            new_alive = _life_rule(count, alive)
             local = tables["local_mask"]
             return {
                 "is_alive": jnp.where(local, new_alive, alive),
@@ -116,12 +133,7 @@ class GameOfLife:
         data_spec = P(SHARD_AXIS)
         idx3 = P(SHARD_AXIS, None, None)
 
-        def rule(count, alive):
-            return jnp.where(
-                count == 3,
-                jnp.uint32(1),
-                jnp.where(count != 2, jnp.uint32(0), alive),
-            )
+        rule = _life_rule
 
         from ..parallel.halo import HaloExchange
 
@@ -169,15 +181,94 @@ class GameOfLife:
 
         return step
 
+    def _build_dense_run(self):
+        """Whole-run device-side loop on the dense y-slab layout: the
+        8-neighbor count is three shifted row bands x three x-rolls, the
+        halo two ppermuted boundary rows — one dispatch for any number of
+        turns (the reference's scalability configuration,
+        ``tests/game_of_life/scalability.cpp``, without its per-turn
+        message machinery)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.dense import HaloExtend
+        from ..parallel.mesh import SHARD_AXIS
+
+        info = self.dense2d
+        nx, nyl, D = info["nx"], info["nyl"], info["D"]
+        per = nyl * nx
+        px, py = info["periodic"]
+        mesh = self.grid.mesh
+        ring = HaloExtend(D)
+        # x-wrap validity columns: neighbor at x+1 invalid for x = nx-1 on
+        # open x; at x-1 invalid for x = 0
+        vx_hi = np.ones(nx, np.uint32)
+        vx_lo = np.ones(nx, np.uint32)
+        if not px:
+            vx_hi[-1] = 0
+            vx_lo[0] = 0
+        vx_of = {-1: jnp.asarray(vx_lo), 0: None, 1: jnp.asarray(vx_hi)}
+
+        def body(alive_rows, turns):
+            a0 = alive_rows[0, :per].reshape(nyl, nx)
+            dev = jax.lax.axis_index(SHARD_AXIS)
+            # boundary-row validity on open y: device 0's below-row and
+            # device D-1's above-row come from the ring wrap and must be
+            # dropped
+            ok_below = jnp.uint32(1 if py else 0) | (dev != 0).astype(jnp.uint32)
+            ok_above = jnp.uint32(1 if py else 0) | (dev != D - 1).astype(jnp.uint32)
+
+            def one(carry):
+                a, _ = carry
+                below, above = ring.planes(a)
+                ext = jnp.concatenate(
+                    [below * ok_below, a, above * ok_above], axis=0
+                )
+                cnt = jnp.zeros((nyl, nx), jnp.uint32)
+                for dy in (0, 1, 2):
+                    band = (ext[dy:dy + nyl] > 0).astype(jnp.uint32)
+                    for dx in (-1, 0, 1):
+                        if dy == 1 and dx == 0:
+                            continue
+                        t = jnp.roll(band, -dx, 1) if dx else band
+                        v = vx_of[dx]
+                        cnt = cnt + (t * v[None, :] if v is not None else t)
+                return _life_rule(cnt, a), cnt
+
+            a, cnt = jax.lax.fori_loop(
+                0, turns, lambda i, c: one(c), (a0, jnp.zeros_like(a0))
+            )
+            out_a = alive_rows[0].at[:per].set(a.reshape(-1))
+            out_c = jnp.zeros_like(out_a).at[:per].set(cnt.reshape(-1))
+            return out_a[None], out_c[None]
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P()),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def run_fn(state, turns):
+            out_a, cnt = fn(state["is_alive"], turns)
+            return {"is_alive": out_a, "live_neighbor_count": cnt}
+
+        return run_fn
+
     def step(self, state):
         return self._step(state)
 
     def run(self, state, turns: int, sync_every: int = 16):
-        """Advance ``turns`` steps.  The dispatch queue is drained every
-        ``sync_every`` turns: unbounded async pipelines of collective
-        programs trip XLA:CPU's rendezvous watchdog on oversubscribed
-        hosts (virtual-device meshes), and a depth-16 pipeline already
-        hides dispatch latency on real chips."""
+        """Advance ``turns`` steps.  On the dense 2-D fast path the whole
+        run is one device-side loop (single dispatch).  Otherwise the
+        dispatch queue is drained every ``sync_every`` turns: unbounded
+        async pipelines of collective programs trip XLA:CPU's rendezvous
+        watchdog on oversubscribed hosts (virtual-device meshes), and a
+        depth-16 pipeline already hides dispatch latency on real chips."""
+        if self._dense_run is not None and turns > 0:
+            return self._dense_run(state, jnp.asarray(turns, jnp.int32))
         for i in range(turns):
             state = self._step(state)
             if sync_every and (i + 1) % sync_every == 0:

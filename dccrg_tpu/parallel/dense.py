@@ -19,7 +19,7 @@ import numpy as np
 
 from .mesh import SHARD_AXIS
 
-__all__ = ["DenseInfo", "detect_dense", "HaloExtend"]
+__all__ = ["DenseInfo", "detect_dense", "detect_dense2d", "HaloExtend"]
 
 
 @dataclass(frozen=True)
@@ -57,14 +57,59 @@ def detect_dense(mapping, topology, leaves, n_devices: int) -> DenseInfo | None:
     )
 
 
-class HaloExtend:
-    """Per-device z-plane halo: extend a ``[nzl, ny, nx]`` block to
-    ``[nzl+2, ny, nx]`` with neighbor devices' boundary planes (ppermute up
-    and down the slab ring).  Intended for use *inside* shard_map bodies."""
+def detect_dense2d(grid, hood_id):
+    """Dense ``[D, ny_local, nx]`` y-slab layout for uniform 2-D grids —
+    the 2-D sibling of :func:`detect_dense` (the reference's hello-world
+    shape, ``simple_game_of_life.cpp``: an (N, N, 1) grid with the full
+    length-1 vertex neighborhood).
 
-    def __init__(self, info: DenseInfo):
+    Under the id-order block partition the dense view is a pure reshape
+    of the row layout (ids are x-fastest, rows ascend in id order), so no
+    gather tables are needed; the halo is two ppermuted boundary rows.
+    Returns None unless: default hood of length 1, nz == 1 with
+    non-periodic z (a periodic z of extent 1 would make every cell its
+    own neighbor), all leaves level 0, and ownership the exact y-slab
+    block striping."""
+    if hood_id is not None:
+        return None
+    epoch = grid.epoch
+    mapping = epoch.mapping
+    nx, ny, nz = (int(v) for v in mapping.length)
+    if nz != 1 or grid.topology.is_periodic(2):
+        return None
+    leaves = epoch.leaves
+    N = len(leaves)
+    if N != nx * ny or N == 0:
+        return None
+    if int(leaves.cells[0]) != 1 or int(leaves.cells[-1]) != N:
+        return None
+    D = epoch.n_devices
+    if ny % D != 0:
+        return None
+    per = N // D
+    expected = np.repeat(np.arange(D, dtype=leaves.owner.dtype), per)
+    if not np.array_equal(leaves.owner, expected):
+        return None
+    hood = np.asarray(grid.neighborhoods[None])
+    if len(hood) != 26 or np.abs(hood).max() != 1:
+        return None
+    return dict(
+        nx=nx, ny=ny, nyl=ny // D, D=D,
+        periodic=(grid.topology.is_periodic(0), grid.topology.is_periodic(1)),
+    )
+
+
+class HaloExtend:
+    """Per-device leading-axis halo: extend a ``[n_loc, ...]`` block to
+    ``[n_loc+2, ...]`` with neighbor devices' boundary slices (ppermute up
+    and down the slab ring) — z planes for the 3-D slab layout, y rows for
+    the 2-D one.  Intended for use *inside* shard_map bodies."""
+
+    def __init__(self, info):
+        """``info``: a DenseInfo, or a plain device count."""
         self.info = info
-        D = info.n_devices
+        D = info if isinstance(info, int) else info.n_devices
+        self.n_devices = D
         self.up = [(i, (i + 1) % D) for i in range(D)]
         self.down = [(i, (i - 1) % D) for i in range(D)]
 
@@ -78,10 +123,9 @@ class HaloExtend:
         """The two received halo planes ``(below, above)`` without
         materializing the concatenated extension — for kernels that splice
         the halo in VMEM instead of re-reading an extended copy from HBM."""
-        info = self.info
         top = blk[-1:]                       # plane sent upward
         bot = blk[:1]                        # plane sent downward
-        if info.n_devices == 1:
+        if self.n_devices == 1:
             return top, bot
         recv_below = jax.lax.ppermute(top, SHARD_AXIS, self.up)
         recv_above = jax.lax.ppermute(bot, SHARD_AXIS, self.down)

@@ -84,3 +84,35 @@ def test_periodic_gol_wraps():
     assert alive == {1 + 0 + 2 * 8, 1 + 0 + 3 * 8, 1 + 0 + 4 * 8}
     state = gol.step(state)
     assert set(gol.alive_cells(state).tolist()) == set(ids)
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 5])
+@pytest.mark.parametrize(
+    "periodic", [(False, False, False), (True, True, False)]
+)
+def test_dense2d_matches_general(n_dev, periodic):
+    """The dense y-slab fast path (whole-run device loop, 8-neighbor
+    count as shifted bands) produces identical alive sets and neighbor
+    counts to the general gather path, at any device count."""
+    g = (
+        Grid()
+        .set_initial_length((10, 10, 1))
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .set_periodic(*periodic)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    rng = np.random.default_rng(0)
+    cells = g.get_cells()
+    alive0 = cells[rng.random(len(cells)) < 0.35]
+    fast = GameOfLife(g)
+    slow = GameOfLife(g, allow_dense=False)
+    assert fast._dense_run is not None
+    assert slow._dense_run is None
+    s = fast.run(fast.new_state(alive_cells=alive0), 13)
+    r = slow.run(slow.new_state(alive_cells=alive0), 13)
+    assert set(fast.alive_cells(s).tolist()) == set(slow.alive_cells(r).tolist())
+    np.testing.assert_array_equal(
+        g.get_cell_data(s, "live_neighbor_count", cells),
+        g.get_cell_data(r, "live_neighbor_count", cells),
+    )
