@@ -45,7 +45,11 @@ class GameOfLife:
     }
 
     def __init__(self, grid, hood_id=None, overlap: bool = False,
-                 allow_dense: bool = True):
+                 allow_dense: bool = True, use_pallas=True):
+        #: use_pallas follows the Advection convention: True = compiled
+        #: kernels on TPU only; "interpret" = force the Pallas
+        #: interpreter (CI/CPU integration coverage); False = XLA only
+        self.use_pallas = use_pallas
         self.grid = grid
         self.hood_id = hood_id
         self._exchange = grid.halo(hood_id)
@@ -200,6 +204,37 @@ class GameOfLife:
         px, py = info["periodic"]
         mesh = self.grid.mesh
         ring = HaloExtend(D)
+
+        # single device + VMEM fit: the whole run in one Pallas launch
+        from ..ops.dense_advection import have_pallas, pallas_available
+        from ..ops.gol_kernel import gol_run_fits, make_gol_run
+
+        interpret = self.use_pallas == "interpret"
+        if (
+            self.use_pallas
+            and have_pallas()
+            and D == 1
+            and gol_run_fits(nyl, nx)
+            and (interpret or pallas_available(np.float32))
+        ):
+            kern = make_gol_run(nyl, nx, px, py, interpret=interpret)
+
+            @jax.jit
+            def fused_fn(state, turns):
+                a = state["is_alive"][0, :per].reshape(nyl, nx)
+                out, cnt = kern((a > 0).astype(jnp.float32), turns)
+                out_a = state["is_alive"][0].at[:per].set(
+                    out.reshape(-1).astype(jnp.uint32)
+                )
+                out_c = jnp.zeros_like(out_a).at[:per].set(
+                    cnt.reshape(-1).astype(jnp.uint32)
+                )
+                return {
+                    "is_alive": out_a[None],
+                    "live_neighbor_count": out_c[None],
+                }
+
+            return fused_fn
         # x-wrap validity columns: neighbor at x+1 invalid for x = nx-1 on
         # open x; at x-1 invalid for x = 0
         vx_hi = np.ones(nx, np.uint32)

@@ -42,6 +42,12 @@ _FUSED_VMEM_BUDGET = 72 * 1024 * 1024
 _FUSED_ARRAYS = 17
 
 
+def have_pallas() -> bool:
+    """Whether the Pallas modules imported (required even for the
+    interpreter path — the kernels reference pl/pltpu unconditionally)."""
+    return _HAVE_PALLAS
+
+
 def pallas_available(dtype) -> bool:
     if not _HAVE_PALLAS:
         return False

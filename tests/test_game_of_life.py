@@ -86,14 +86,18 @@ def test_periodic_gol_wraps():
     assert set(gol.alive_cells(state).tolist()) == set(ids)
 
 
-@pytest.mark.parametrize("n_dev", [1, 2, 5])
+@pytest.mark.parametrize(
+    "n_dev,use_pallas", [(1, "interpret"), (1, False), (2, True), (5, True)]
+)
 @pytest.mark.parametrize(
     "periodic", [(False, False, False), (True, True, False)]
 )
-def test_dense2d_matches_general(n_dev, periodic):
+def test_dense2d_matches_general(n_dev, use_pallas, periodic):
     """The dense y-slab fast path (whole-run device loop, 8-neighbor
     count as shifted bands) produces identical alive sets and neighbor
-    counts to the general gather path, at any device count."""
+    counts to the general gather path, at any device count — including
+    the single-device fused Pallas kernel via the interpreter and the
+    XLA dense loop it falls back to."""
     g = (
         Grid()
         .set_initial_length((10, 10, 1))
@@ -105,7 +109,7 @@ def test_dense2d_matches_general(n_dev, periodic):
     rng = np.random.default_rng(0)
     cells = g.get_cells()
     alive0 = cells[rng.random(len(cells)) < 0.35]
-    fast = GameOfLife(g)
+    fast = GameOfLife(g, use_pallas=use_pallas)
     slow = GameOfLife(g, allow_dense=False)
     assert fast._dense_run is not None
     assert slow._dense_run is None
