@@ -20,6 +20,12 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
+# host-side measurements must not depend on (or hang with) an accelerator
+# tunnel; force the CPU backend like tests/conftest.py
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 
