@@ -159,6 +159,12 @@ class Grid:
         if not self.initialized:
             raise RuntimeError("grid not initialized")
 
+    def _assert_no_staged_lb(self):
+        """Structural mutators are forbidden while a staged balance_load
+        is pending: the staged epoch reflects the current leaf set."""
+        if getattr(self, "_staged_lb", None) is not None:
+            raise RuntimeError("a staged balance_load is in progress")
+
     def get_cells(self) -> np.ndarray:
         """All existing (leaf) cells, ascending id — global view."""
         self._assert_initialized()
@@ -405,6 +411,7 @@ class Grid:
         ``dccrg.hpp:6383-6555``).  As in the reference, the offsets must fit
         inside the default neighborhood so ghost requirements (and hence
         payload layouts) are unchanged; existing states remain valid."""
+        self._assert_no_staged_lb()
         self._assert_initialized()
         if hood_id in self.neighborhoods or hood_id is None:
             return False
@@ -433,6 +440,7 @@ class Grid:
     def set_cell_weight(self, cell, weight: float) -> bool:
         """Per-cell load-balance weight (reference ``dccrg.hpp:6210-6276``;
         default weight 1)."""
+        self._assert_no_staged_lb()
         if not self.leaves.exists(np.uint64(cell)):
             return False
         self.cell_weights[int(cell)] = float(weight)
@@ -482,59 +490,26 @@ class Grid:
         pins override) and rebuild all derived state — the reference's
         3-phase ``balance_load`` (``dccrg.hpp:1024-1044, 3741-4147``)
         collapsed into one host-side step; carry payloads over with
-        ``remap_state`` (pure ownership moves keep every cell's value)."""
+        ``remap_state`` (pure ownership moves keep every cell's value).
+        For chunked payload migration use ``initialize_balance_load`` /
+        ``continue_balance_load`` / ``finish_balance_load``."""
         self._assert_initialized()
-        from .parallel.loadbalance import compute_partition
-        from .utils.collectives import sync_partition_inputs
-
-        # multi-controller agreement on pins/weights before partitioning
-        # (update_pin_requests All_Gather, dccrg.hpp:8297-8340) — a
-        # transient merged view; this controller's own dicts stay local.
-        # Identity under the single controller.
-        all_pins, all_weights = sync_partition_inputs(
-            self.pin_requests, self.cell_weights
-        )
-
-        weights = None
-        if all_weights:
-            weights = np.ones(len(self.leaves))
-            for c, w in all_weights.items():
-                p = int(self.leaves.position(np.uint64(c)))
-                if p >= 0:
-                    weights[p] = w
-
-        method = self._lb_method if use_zoltan else "NONE"
-        options = self.get_partitioning_options()
-        hier = getattr(self, "_hier_levels", None)
-        if hier and method.upper() != "NONE":
-            owner = self._hierarchical_partition(method, weights, hier, options)
-        else:
-            owner = compute_partition(
-                method, self, self.n_devices, weights, options
-            )
-
-        # pins override the partitioner (make_new_partition,
-        # dccrg.hpp:8417-8580)
-        for c, d in all_pins.items():
-            p = int(self.leaves.position(np.uint64(c)))
-            if p >= 0:
-                owner[p] = d
-
-        from .core.neighbors import LeafSet
-
+        if getattr(self, "_staged_lb", None) is not None:
+            raise RuntimeError("a staged balance_load is in progress")
+        owner = self._compute_new_owner(use_zoltan)
         self._prev_epoch = self.epoch
         self._last_new_cells = np.zeros(0, dtype=np.uint64)
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
         # load balancing cancels pending adaptation (reference: requests
         # are lost after balance_load, dccrg.hpp:2666-2668)
         self.amr.clear()
-        if np.array_equal(owner.astype(np.int32), self.leaves.owner):
+        if np.array_equal(owner, self.leaves.owner):
             # no cell moved: every derived table is still valid, skip the
             # (expensive) epoch rebuild; remap_state degenerates to the
             # identity (checkpoint reload hits this on its post-replay
             # balance when the partitioner reproduces the current owners)
             return self
-        self.leaves = LeafSet(cells=self.leaves.cells, owner=owner.astype(np.int32))
+        self.leaves = LeafSet(cells=self.leaves.cells, owner=owner)
         self._rebuild()
         return self
 
@@ -607,18 +582,147 @@ class Grid:
         )
         return owner
 
+    def _compute_new_owner(self, use_zoltan: bool) -> np.ndarray:
+        """The new per-leaf owner array: multi-controller pin/weight
+        agreement, partitioner, pin overrides."""
+        from .parallel.loadbalance import compute_partition
+        from .utils.collectives import sync_partition_inputs
+
+        # multi-controller agreement on pins/weights before partitioning
+        # (update_pin_requests All_Gather, dccrg.hpp:8297-8340) — a
+        # transient merged view; this controller's own dicts stay local.
+        # Identity under the single controller.
+        all_pins, all_weights = sync_partition_inputs(
+            self.pin_requests, self.cell_weights
+        )
+
+        weights = None
+        if all_weights:
+            weights = np.ones(len(self.leaves))
+            for c, w in all_weights.items():
+                p = int(self.leaves.position(np.uint64(c)))
+                if p >= 0:
+                    weights[p] = w
+
+        method = self._lb_method if use_zoltan else "NONE"
+        options = self.get_partitioning_options()
+        hier = getattr(self, "_hier_levels", None)
+        if hier and method.upper() != "NONE":
+            owner = self._hierarchical_partition(method, weights, hier, options)
+        else:
+            owner = compute_partition(
+                method, self, self.n_devices, weights, options
+            )
+
+        # pins override the partitioner (make_new_partition,
+        # dccrg.hpp:8417-8580)
+        for c, d in all_pins.items():
+            p = int(self.leaves.position(np.uint64(c)))
+            if p >= 0:
+                owner[p] = d
+        return np.asarray(owner).astype(np.int32)
+
     def initialize_balance_load(self, use_zoltan: bool = True):
-        """Split-phase parity API (reference ``dccrg.hpp:3741-3884``): the
-        partition is computed eagerly; data movement happens in
-        ``remap_state`` which the finish step returns."""
-        self.balance_load(use_zoltan)
+        """Phase 1 of the reference's split balance_load
+        (``dccrg.hpp:3741-3884``): compute the new partition and build the
+        new derived state WITHOUT touching the live grid — queries and
+        stencils keep working on the old layout while payload chunks
+        migrate through ``continue_balance_load``."""
+        self._assert_initialized()
+        if getattr(self, "_staged_lb", None) is not None:
+            raise RuntimeError("a staged balance_load is in progress")
+        owner = self._compute_new_owner(use_zoltan)
+        # load balancing cancels pending adaptation (dccrg.hpp:2666-2668)
+        self.amr.clear()
+        if np.array_equal(owner, self.leaves.owner):
+            self._staged_lb = {"noop": True}
+            return self
+        new_leaves = LeafSet(cells=self.leaves.cells, owner=owner)
+        new_epoch = build_epoch(
+            self.mapping, self.topology, new_leaves, self.n_devices,
+            self.neighborhoods,
+        )
+        self._staged_lb = {
+            "noop": False,
+            "leaves": new_leaves,
+            "epoch": new_epoch,
+            "staged": None,
+            "host_old": None,
+            "done": 0,
+        }
         return self
 
-    def continue_balance_load(self):
-        return self
+    def continue_balance_load(self, state=None, max_cells=None) -> bool:
+        """Phase 2, repeatable (``dccrg.hpp:3892-3934``): migrate the next
+        ``max_cells`` leaves' payload rows into the staged new layout.
+        Each call reads from the state PASSED TO IT (only the chunk's rows
+        leave the device), so callers overlapping migration with compute
+        must pass the state they want captured for that chunk — the same
+        contract as the reference, which ships whatever is in cell_data at
+        continue time.  Returns True while more cells remain; no ``state``
+        means nothing to move (False)."""
+        st = getattr(self, "_staged_lb", None)
+        if st is None:
+            raise RuntimeError("initialize_balance_load has not been called")
+        if st.get("noop") or state is None:
+            return False
+        N = len(self.leaves)
+        old, new = self.epoch, st["epoch"]
+        if st["staged"] is None:
+            st["staged"] = {
+                k: np.zeros(
+                    (new.n_devices, new.R) + tuple(v.shape[2:]),
+                    np.dtype(v.dtype),
+                )
+                for k, v in state.items()
+            }
+        lo = st["done"]
+        hi = N if max_cells is None else min(lo + int(max_cells), N)
+        if lo < hi:
+            pos = np.arange(lo, hi)
+            d_old, r_old = old.leaves.owner[pos], old.row_of[pos]
+            d_new, r_new = new.leaves.owner[pos], new.row_of[pos]
+            for k, arr in state.items():
+                st["staged"][k][d_new, r_new] = np.asarray(arr[d_old, r_old])
+            st["done"] = hi
+        return hi < N
 
-    def finish_balance_load(self):
-        return self
+    def finish_balance_load(self, state=None):
+        """Phase 3 (``dccrg.hpp:3942-4147``): commit the new directory and
+        derived state.  Remaining chunks are drained from ``state`` first;
+        returns the migrated state when payloads were staged, else the
+        grid.  A partial migration with no ``state`` to finish from is an
+        error (the staged copy would silently be incomplete)."""
+        st = getattr(self, "_staged_lb", None)
+        if st is None:
+            raise RuntimeError("initialize_balance_load has not been called")
+        if st.get("noop"):
+            self._staged_lb = None
+            self._prev_epoch = self.epoch
+            self._last_new_cells = np.zeros(0, dtype=np.uint64)
+            self._last_removed_cells = np.zeros(0, dtype=np.uint64)
+            return state if state is not None else self
+        if state is not None:
+            while self.continue_balance_load(state):
+                pass
+        elif st["staged"] is not None and st["done"] < len(self.leaves):
+            raise RuntimeError(
+                "migration is partial; pass the state to finish_balance_load"
+            )
+        self._staged_lb = None
+        self._prev_epoch = self.epoch
+        self._last_new_cells = np.zeros(0, dtype=np.uint64)
+        self._last_removed_cells = np.zeros(0, dtype=np.uint64)
+        self.leaves = st["leaves"]
+        self.epoch = st["epoch"]
+        self._halo_cache = {}
+        self._id_pos_cache = None
+        if st["staged"] is None:
+            return self
+        return {
+            k: jax.device_put(jnp.asarray(v), shard_spec(self.mesh, v.ndim))
+            for k, v in st["staged"].items()
+        }
 
     # ------------------------------------------------------------------ AMR
 
@@ -780,6 +884,7 @@ class Grid:
         cells.  Payload states allocated before this call must be carried
         over with ``remap_state``.  ``presynced`` skips the multi-controller
         queue union for callers that already ran ``sync_adaptation``."""
+        self._assert_no_staged_lb()
         self._assert_initialized()
         from .amr.refinement import commit_adaptation
         from .utils.collectives import sync_adaptation

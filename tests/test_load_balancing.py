@@ -343,3 +343,95 @@ def test_hilbert_partition_balanced_and_smaller_surface():
     gh.stop_refining()
     gh.balance_load()
     verify_grid(gh)
+
+
+def test_three_phase_balance_load_chunked():
+    """The real split balance_load: initialize stages the new partition
+    without touching the live grid, continue migrates payload chunks
+    (repeatable), finish commits and returns the migrated state —
+    equivalent to the one-shot balance_load + remap_state."""
+    from dccrg_tpu import CartesianGeometry
+
+    def build():
+        g = (
+            Grid()
+            .set_initial_length((8, 8, 8))
+            .set_neighborhood_length(1)
+            .set_load_balancing_method("GRAPH")
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / 8,) * 3,
+            )
+            .initialize(mesh=make_mesh(n_devices=4))
+        )
+        state = g.new_state({"rho": ((), np.float64)})
+        cells = g.get_cells()
+        state = g.set_cell_data(
+            state, "rho", cells, np.sin(cells.astype(np.float64))
+        )
+        return g, state, cells
+
+    # reference result: one-shot
+    g1, s1, cells = build()
+    g1.balance_load()
+    s1 = g1.remap_state(s1)
+    want_owner = g1.leaves.owner.copy()
+    want = g1.get_cell_data(s1, "rho", cells)
+
+    # three-phase with small chunks
+    g2, s2, _ = build()
+    old_owner = g2.leaves.owner.copy()
+    g2.initialize_balance_load()
+    # live grid untouched while staged
+    np.testing.assert_array_equal(g2.leaves.owner, old_owner)
+    n_chunks = 0
+    while g2.continue_balance_load(s2, max_cells=100):
+        n_chunks += 1
+    assert n_chunks >= 5  # 512 cells / 100 per chunk
+    out = g2.finish_balance_load()
+    assert isinstance(out, dict)
+    np.testing.assert_array_equal(g2.leaves.owner, want_owner)
+    np.testing.assert_array_equal(g2.get_cell_data(out, "rho", cells), want)
+
+    # remap_state still works for payloads not carried through the phases
+    s2b = g2.remap_state(s2)
+    np.testing.assert_array_equal(g2.get_cell_data(s2b, "rho", cells), want)
+
+
+def test_three_phase_finish_drains_remaining():
+    """finish_balance_load drains unmigrated chunks from the passed
+    state; a partial migration with no state to finish from is an
+    error (the staged copy would silently be incomplete)."""
+    from dccrg_tpu import CartesianGeometry
+
+    g = (
+        Grid()
+        .set_initial_length((6, 6, 6))
+        .set_neighborhood_length(1)
+        .set_load_balancing_method("GRAPH")
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / 6,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=4))
+    )
+    state = g.new_state({"rho": ((), np.float64)})
+    cells = g.get_cells()
+    vals = np.cos(cells.astype(np.float64))
+    state = g.set_cell_data(state, "rho", cells, vals)
+    g.initialize_balance_load()
+    g.continue_balance_load(state, max_cells=10)   # one partial chunk
+    with pytest.raises(RuntimeError, match="partial"):
+        g.finish_balance_load()
+    out = g.finish_balance_load(state)
+    np.testing.assert_array_equal(g.get_cell_data(out, "rho", cells), vals)
+
+    # guards: structural mutators are refused while a balance is staged
+    g.initialize_balance_load()
+    with pytest.raises(RuntimeError, match="in progress"):
+        g.balance_load()
+    with pytest.raises(RuntimeError, match="in progress"):
+        g.stop_refining()
+    g.finish_balance_load()
