@@ -48,6 +48,39 @@ def run_sweep(workload: str, counts, size: int, turns: int):
             jax.block_until_ready(state)
             secs = time.perf_counter() - t0
             n_cells = size * size
+        elif workload == "refined":
+            # the reference's refined_scalability3d.cpp analogue: a
+            # two-level AMR advection sweep (boxed per-level path)
+            n = max(8, size // 16)
+            nz = max(n_dev * 2, 8)
+            grid = (
+                Grid()
+                .set_initial_length((n, n, nz))
+                .set_neighborhood_length(0)
+                .set_periodic(True, True, True)
+                .set_maximum_refinement_level(1)
+                .set_geometry(
+                    CartesianGeometry,
+                    start=(0.0, 0.0, 0.0),
+                    level_0_cell_length=(1.0 / n, 1.0 / n, 1.0 / nz),
+                )
+                .initialize(mesh=mesh)
+            )
+            ids = grid.get_cells()
+            c = grid.geometry.get_center(ids)
+            r = np.linalg.norm(c - 0.5, axis=1)
+            for cid in ids[r < 0.3]:
+                grid.refine_completely(int(cid))
+            grid.stop_refining()
+            adv = Advection(grid, dtype=np.float32, allow_dense=False)
+            state = adv.initialize_state()
+            dt = np.float32(0.4 * adv.max_time_step(state))
+            jax.block_until_ready(adv.run(state, 2, dt))
+            t0 = time.perf_counter()
+            state = adv.run(state, turns, dt)
+            jax.block_until_ready(state)
+            secs = time.perf_counter() - t0
+            n_cells = len(grid.get_cells())
         else:
             grid = (
                 Grid()
@@ -85,7 +118,8 @@ def run_sweep(workload: str, counts, size: int, turns: int):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("workload", nargs="?", default="gol", choices=["gol", "advection"])
+    ap.add_argument("workload", nargs="?", default="gol",
+                    choices=["gol", "advection", "refined"])
     ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
     ap.add_argument("--size", type=int, default=256)
     ap.add_argument("--turns", type=int, default=20)
