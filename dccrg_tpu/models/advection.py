@@ -223,15 +223,32 @@ class Advection:
         grid (ops/flat_amr.py): the entire run loop in VMEM, one launch.
         None when the grid/device/dtype does not qualify; the boxed path
         remains the general fallback (and the step()/indicator path)."""
-        from ..ops.dense_advection import pallas_available
+        from ..ops.dense_advection import have_pallas, pallas_available
         from ..ops.flat_amr import (
+            build_flat_amr_sharded,
             build_flat_amr_tables,
             compute_flat_weights,
             make_flat_amr_run,
+            make_flat_amr_run_sharded,
         )
 
-        interpret = self.use_pallas == "interpret"
+        # use_pallas doubles as the fast-path opt-out: False always means
+        # the reference boxed numerics
         if not self.use_pallas:
+            return None
+
+        # multi-device: z-slab-sharded XLA form (no Pallas requirement)
+        ts = build_flat_amr_sharded(self.grid)
+        if ts is not None:
+            jdt = (
+                jnp.float32
+                if np.dtype(self.dtype) == np.float32
+                else jnp.float64
+            )
+            return make_flat_amr_run_sharded(self.grid, ts, dtype=jdt)
+
+        interpret = self.use_pallas == "interpret"
+        if not have_pallas():
             return None
         if np.dtype(self.dtype) != np.float32:
             return None

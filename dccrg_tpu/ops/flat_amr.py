@@ -50,7 +50,13 @@ try:
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
-__all__ = ["build_flat_amr_tables", "make_flat_amr_run", "flat_amr_fits"]
+__all__ = [
+    "build_flat_amr_tables",
+    "make_flat_amr_run",
+    "flat_amr_fits",
+    "build_flat_amr_sharded",
+    "make_flat_amr_run_sharded",
+]
 
 #: VMEM cap: ~18 resident arrays (ping/pong state, 6 weights, 2 update
 #: masks, temporaries) — see make_fused_run's budget reasoning
@@ -137,6 +143,31 @@ def build_flat_amr_tables(grid):
     )
 
 
+def _face_weights(vl, vh, fl, fh, pos, area_d, dtype, extra_invalid=None):
+    """Signed upwind weight pair for the faces pairing (low, high) voxel
+    planes: face velocity with the reference's 2:1 length weighting
+    (``solve.hpp:168-175``), intra-coarse-block pairs (low side at even
+    position) carry no face, ``extra_invalid`` masks e.g. non-periodic
+    wrap faces.  Shared by the single-device kernel weights and the
+    sharded run so the numerics cannot drift apart."""
+    third = dtype(1.0 / 3.0)
+    vface = jnp.where(
+        fl == fh,
+        dtype(0.5) * (vl + vh),               # same-kind: plain average
+        jnp.where(
+            fl,                                # fine low, coarse high
+            (dtype(2.0) * vl + vh) * third,
+            (vl + dtype(2.0) * vh) * third,
+        ),
+    )
+    valid = ~((~fl) & (~fh) & (pos % 2 == 0))
+    if extra_invalid is not None:
+        valid = valid & ~extra_invalid
+    w = jnp.where(valid, vface * dtype(area_d), dtype(0.0))
+    wp = jnp.where(vface >= 0, w, dtype(0.0))
+    return wp, w - wp
+
+
 def compute_flat_weights(tables, VX, VY, VZ, dtype=jnp.float32):
     """Per-voxel-face upwind weights (jittable; velocities are run inputs
     but loop-invariant, so this runs once per run call).
@@ -154,28 +185,12 @@ def compute_flat_weights(tables, VX, VY, VZ, dtype=jnp.float32):
         ax = 2 - d
         n = (nx1, ny1, nz1)[d]
         v = vels[d].astype(dtype)
-        vl, vh = v, jnp.roll(v, -1, ax)
-        fl, fh = leaf, jnp.roll(leaf, -1, ax)
-        third = dtype(1.0 / 3.0)
-        vface = jnp.where(
-            fl == fh,
-            dtype(0.5) * (vl + vh),           # same-kind: plain average
-            jnp.where(
-                fl,                            # fine below, coarse above
-                (dtype(2.0) * vl + vh) * third,
-                (vl + dtype(2.0) * vh) * third,
-            ),
-        )
-        # validity: intra-block coarse pairs carry no face; the wrap face
-        # (n-1 -> 0) exists only on periodic axes
         pos = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), ax)
-        intra = (~fl) & (~fh) & (pos % 2 == 0)
-        valid = ~intra
-        if not periodic[d]:
-            valid = valid & (pos != n - 1)
-        w = jnp.where(valid, vface * dtype(area[d]), dtype(0.0))
-        wp = jnp.where(vface >= 0, w, dtype(0.0))
-        out.append((wp, w - wp))
+        extra = None if periodic[d] else (pos == n - 1)
+        out.append(_face_weights(
+            v, jnp.roll(v, -1, ax), leaf, jnp.roll(leaf, -1, ax),
+            pos, area[d], dtype, extra,
+        ))
     return out
 
 
@@ -280,3 +295,240 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
                     upd_f, upd_c)
 
     return run
+
+
+def build_flat_amr_sharded(grid):
+    """Multi-device flat layout: the level-1-resolution domain z-slab
+    sharded over the mesh, one slab per device — the multi-chip form of
+    the flat scheme, with the per-step halo two ppermuted voxel planes
+    (the same wire pattern as the uniform dense path).
+
+    Requires: levels {0, 1}, Cartesian, nz0 divisible by the device count
+    (slabs then hold whole coarse blocks: nzl1 = 2 nz0/D is even), and
+    ownership equal to the voxel-slab partition.  Returns the static
+    tables dict or None."""
+    from ..geometry.cartesian import CartesianGeometry
+    from ..geometry.stretched import StretchedCartesianGeometry
+
+    epoch = grid.epoch
+    D = epoch.n_devices
+    if D == 1:
+        return None
+    if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
+        grid.geometry, StretchedCartesianGeometry
+    ):
+        return None
+    mapping = epoch.mapping
+    leaves = epoch.leaves
+    N = len(leaves)
+    if N == 0:
+        return None
+    lvl = mapping.get_refinement_level(leaves.cells).astype(np.int64)
+    if lvl.max() != 1 or lvl.min() != 0:
+        return None
+    nx0, ny0, nz0 = (int(v) for v in mapping.length)
+    if nz0 % D != 0:
+        return None
+    L = mapping.max_refinement_level
+    nx1, ny1, nz1 = 2 * nx0, 2 * ny0, 2 * nz0
+    nzl1 = nz1 // D
+    n_loc = nzl1 * ny1 * nx1
+    n_vox = nx1 * ny1 * nz1
+    # cost guards (mirroring the boxed path's max_expand and the
+    # single-device flat_amr_fits): the 8x inflation must stay within a
+    # modest factor of the real leaf count, and the ~12 per-device
+    # voxel-resolution arrays must fit comfortably in HBM — otherwise the
+    # boxed path (cost proportional to real leaves) is the better choice
+    if n_vox > max(8 * N, 1 << 22):
+        return None
+    if 12 * n_loc * 4 > (2 << 30):
+        return None
+
+    idx = mapping.get_indices(leaves.cells).astype(np.int64)  # (N,3) x,y,z
+    vox = idx >> (L - 1)
+    owner_expected = (vox[:, 2] // nzl1).astype(leaves.owner.dtype)
+    if not np.array_equal(leaves.owner, owner_expected):
+        return None
+
+    zl = vox[:, 2] % nzl1
+    flat_loc = (zl * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
+
+    rows = np.zeros((D, n_loc), dtype=np.int32)
+    leaf_fine = np.zeros((D, nzl1, ny1, nx1), dtype=bool)
+    dev = leaves.owner.astype(np.int64)
+    fine = lvl == 1
+    rows[dev[fine], flat_loc[fine]] = epoch.row_of[fine]
+    lf_flat = leaf_fine.reshape(D, -1)
+    lf_flat[dev[fine], flat_loc[fine]] = True
+    coarse = np.flatnonzero(~fine)
+    for dz in range(2):
+        for dy in range(2):
+            for dx in range(2):
+                off = (dz * ny1 + dy) * nx1 + dx
+                rows[dev[coarse], flat_loc[coarse] + off] = (
+                    epoch.row_of[coarse]
+                )
+
+    R = epoch.R
+    wb_rows = np.zeros((D, R), dtype=np.int32)
+    wb_valid = np.zeros((D, R), dtype=bool)
+    wb_rows[dev, epoch.row_of] = flat_loc
+    wb_valid[dev, epoch.row_of] = True
+
+    # ringed leaf mask: the z-neighbor devices' edge planes (static data
+    # needs no collective — build it globally and slice)
+    lf_global = np.zeros((nz1, ny1, nx1), dtype=bool)
+    gz = vox[:, 2]
+    gflat = (gz * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
+    lf_g = lf_global.reshape(-1)
+    lf_g[gflat[fine]] = True
+    leaf_ext = np.stack([
+        np.concatenate([
+            lf_global[(d * nzl1 - 1) % nz1][None],
+            lf_global[d * nzl1:(d + 1) * nzl1],
+            lf_global[((d + 1) * nzl1) % nz1][None],
+        ])
+        for d in range(D)
+    ])
+
+    l1 = np.asarray(grid.geometry.get_level_0_cell_length(), np.float64) / 2.0
+    return dict(
+        shape=(nzl1, ny1, nx1),
+        n_devices=D,
+        rows=rows,
+        leaf_fine=leaf_fine,
+        leaf_ext=leaf_ext,
+        wb_rows=wb_rows,
+        wb_valid=wb_valid,
+        area_f=np.array([l1[1] * l1[2], l1[0] * l1[2], l1[0] * l1[1]]),
+        vol_f=float(l1.prod()),
+        vol_c=float(l1.prod() * 8.0),
+        periodic=tuple(bool(grid.topology.is_periodic(d)) for d in range(3)),
+    )
+
+
+def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
+    """The jitted multi-device flat run: one shard_map around the whole
+    fori_loop; per step two ppermuted voxel planes and one weighted flux
+    pass + intra-slab pool/broadcast (coarse blocks never straddle slabs,
+    so the coarse update is collective-free).  Weight arrays are computed
+    once per run from the (ringed) velocity fields."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.dense import HaloExtend
+    from ..parallel.mesh import SHARD_AXIS, shard_spec
+
+    nzl1, ny1, nx1 = tables["shape"]
+    D = tables["n_devices"]
+    px, py, pz = tables["periodic"]
+    area = tables["area_f"]
+    inv_vf = dtype(1.0 / tables["vol_f"])
+    inv_vc = dtype(1.0 / tables["vol_c"])
+    mesh = grid.mesh
+    ring = HaloExtend(D)
+
+    def body(rows, leaf, leaf_ext, wbr, wbv, rho_rows, vx_rows, vy_rows,
+             vz_rows, dt, steps):
+        rows, leaf, leaf_ext = rows[0], leaf[0], leaf_ext[0]
+        wbr, wbv = wbr[0], wbv[0]
+        dev = jax.lax.axis_index(SHARD_AXIS)
+
+        def field(arr_rows):
+            return arr_rows[0][rows].reshape(nzl1, ny1, nx1).astype(dtype)
+
+        V = field(rho_rows)
+        VX, VY, VZ = field(vx_rows), field(vy_rows), field(vz_rows)
+
+        # ---- x/y weights via the shared helper (full-domain extents,
+        # rolls = wrap)
+        w_xy = []
+        for d2, vel, n in ((0, VX, nx1), (1, VY, ny1)):
+            ax = 2 - d2
+            pos = jax.lax.broadcasted_iota(jnp.int32, (nzl1, ny1, nx1), ax)
+            periodic_d = px if d2 == 0 else py
+            extra = None if periodic_d else (pos == n - 1)
+            w_xy.append(_face_weights(
+                vel, jnp.roll(vel, -1, ax), leaf, jnp.roll(leaf, -1, ax),
+                pos, area[d2], dtype, extra,
+            ))
+        (wpx, wnx), (wpy, wny) = w_xy
+
+        # ---- z weights on the nzl1+1 faces of the ringed slab: face j
+        # pairs ext planes (j, j+1); global face index dev*nzl1 - 1 + j
+        # (the shared helper's parity mask needs the GLOBAL position)
+        below_v, above_v = ring.planes(VZ)
+        VZe = jnp.concatenate([below_v, VZ, above_v], axis=0)
+        gface = (
+            dev * nzl1 - 1
+            + jax.lax.broadcasted_iota(jnp.int32, (nzl1 + 1, ny1, nx1), 0)
+        )
+        extra_z = (
+            None if pz else (gface == -1) | (gface == D * nzl1 - 1)
+        )
+        wzp, wzn = _face_weights(
+            VZe[:-1], VZe[1:], leaf_ext[:-1], leaf_ext[1:],
+            gface, area[2], dtype, extra_z,
+        )
+
+        # ---- static update masks
+        updf = leaf.astype(dtype) * inv_vf
+        pool = (~leaf).astype(dtype)
+        updc = pool * inv_vc
+        ex = jax.lax.broadcasted_iota(jnp.int32, (nzl1, ny1, nx1), 2) % 2 == 0
+        ey = jax.lax.broadcasted_iota(jnp.int32, (nzl1, ny1, nx1), 1) % 2 == 0
+        ez = jax.lax.broadcasted_iota(jnp.int32, (nzl1, ny1, nx1), 0) % 2 == 0
+        orig = (ex & ey & ez).astype(dtype)
+
+        def one(i, Vc):
+            fx = Vc * wpx + jnp.roll(Vc, -1, 2) * wnx
+            fy = Vc * wpy + jnp.roll(Vc, -1, 1) * wny
+            below, above = ring.planes(Vc)
+            Ve = jnp.concatenate([below, Vc, above], axis=0)
+            fz_faces = Ve[:-1] * wzp + Ve[1:] * wzn      # (nzl1+1, ...)
+            delta = jnp.roll(fx, 1, 2) - fx
+            delta = delta + jnp.roll(fy, 1, 1) - fy
+            delta = delta + fz_faces[:-1] - fz_faces[1:]
+            s = delta * pool
+            s = s + jnp.roll(s, -1, 2)
+            s = s + jnp.roll(s, -1, 1)
+            s = s + jnp.roll(s, -1, 0)
+            s = s * orig
+            s = s + jnp.roll(s, 1, 2)
+            s = s + jnp.roll(s, 1, 1)
+            s = s + jnp.roll(s, 1, 0)
+            return Vc + dt * (delta * updf + s * updc)
+
+        out = jax.lax.fori_loop(0, steps, one, V)
+        rho = jnp.where(wbv, out.reshape(-1)[wbr], rho_rows[0])
+        return rho[None]
+
+    data_spec = P(SHARD_AXIS)
+    spec2 = P(SHARD_AXIS, None)
+    spec4 = P(SHARD_AXIS, None, None, None)
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec2, spec4, spec4, spec2, spec2,
+                  data_spec, data_spec, data_spec, data_spec, P(), P()),
+        out_specs=data_spec,
+        check_vma=False,
+    )
+
+    put = lambda a: jax.device_put(jnp.asarray(a), shard_spec(mesh, np.ndim(a)))
+    statics = tuple(put(tables[k]) for k in
+                    ("rows", "leaf_fine", "leaf_ext", "wb_rows", "wb_valid"))
+
+    @jax.jit
+    def run_fn(state, steps, dt):
+        rho = sm(
+            *statics,
+            state["density"], state["vx"], state["vy"], state["vz"],
+            jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
+        )
+        return {
+            **state,
+            "density": rho.astype(state["density"].dtype),
+            "flux": jnp.zeros_like(state["flux"]),
+        }
+
+    return run_fn
