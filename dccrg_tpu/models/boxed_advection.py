@@ -176,8 +176,15 @@ def build_boxed_run(adv, layout):
                     m_highf_i[d][-1] |= edge
                 else:
                     edge_planes[d] = edge
-        m_lowf = np.stack([pad3(m_lowf_i[d], xy_wrap=False) for d in range(3)])
-        m_highf = np.stack([pad3(m_highf_i[d], xy_wrap=False)
+        # cross-face masks ring-pad with CONSTANT False on every axis —
+        # including z: their box-edge faces are placed explicitly below
+        # (ring row 0 / slab re-registration), and a wrap pad would copy
+        # interior cross-face registrations onto the opposite ring row as
+        # spurious faces, which local mode's pooled wrap segments then
+        # deliver as phantom fluxes into the far-side coarse cells
+        m_lowf = np.stack([pad3(m_lowf_i[d], xy_wrap=False, z_wrap=False)
+                           for d in range(3)])
+        m_highf = np.stack([pad3(m_highf_i[d], xy_wrap=False, z_wrap=False)
                             for d in range(3)])
         for d, edge in edge_planes.items():
             ax = 2 - d

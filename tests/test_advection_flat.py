@@ -193,3 +193,27 @@ def test_flat_sharded_device_count_invariant():
     r1 = run(1)
     r4 = run(4)
     np.testing.assert_allclose(r1, r4, rtol=2e-7, atol=1e-9)
+
+
+def test_flat_run_feeds_adaptation_cycle():
+    """A flat-path run's state drives check_for_adaptation/adapt_grid
+    without conversion (the run returns the row layout), and the new
+    model rebuilds its fast paths for the adapted grid."""
+    g = make()
+    adv = Advection(g, dtype=np.float32, use_pallas="interpret")
+    assert adv._flat_run is not None
+    s0, ids = seeded_state(adv, g)
+    dt = np.float32(0.3 * adv.max_time_step(s0))
+    state = adv.run(s0, 5, dt)
+    m0 = lvl_mass(g, ids, adv.get_cell_data(state, "density", ids))
+
+    adv.check_for_adaptation(state)
+    adv2, state2, _new, _removed = adv.adapt_grid(state)
+    ids2 = adv2.grid.get_cells()
+    m1 = lvl_mass(adv2.grid, ids2, adv2.get_cell_data(state2, "density", ids2))
+    assert m1 == pytest.approx(m0, rel=1e-5)
+    # the new model runs (flat rebuilt if the grid still qualifies,
+    # boxed otherwise)
+    out = adv2.run(state2, 3, np.float32(0.3 * adv2.max_time_step(state2)))
+    m2 = lvl_mass(adv2.grid, ids2, adv2.get_cell_data(out, "density", ids2))
+    assert m2 == pytest.approx(m1, rel=1e-5)
