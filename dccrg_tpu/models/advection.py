@@ -67,7 +67,10 @@ class Advection:
             self.boxed = build_boxed(grid, hood_id)
             if self.boxed is not None:
                 self._boxed_run = self._build_boxed_run(self.boxed)
-                self._flat_run = self._build_flat_run()
+            # the flat two-level scheme qualifies independently of the
+            # boxed layout (e.g. wrap-adjacent refinement is gated out of
+            # slab-mode boxed but handled exactly by the flat rolls)
+            self._flat_run = self._build_flat_run()
 
     # ------------------------------------------------------ static tables
 
