@@ -176,16 +176,26 @@ def build_boxed_run(adv, layout):
                     m_highf_i[d][-1] |= edge
                 else:
                     edge_planes[d] = edge
-        # cross-face masks ring-pad with CONSTANT False on every axis —
-        # including z: their box-edge faces are placed explicitly below
-        # (ring row 0 / slab re-registration), and a wrap pad would copy
-        # interior cross-face registrations onto the opposite ring row as
-        # spurious faces, which local mode's pooled wrap segments then
-        # deliver as phantom fluxes into the far-side coarse cells
-        m_lowf = np.stack([pad3(m_lowf_i[d], xy_wrap=False, z_wrap=False)
-                           for d in range(3)])
-        m_highf = np.stack([pad3(m_highf_i[d], xy_wrap=False, z_wrap=False)
-                            for d in range(3)])
+        # Cross-face mask ring padding is MODE-dependent along z:
+        # * slab mode wrap-pads — the global rings must be circularly
+        #   consistent so each device's ring rows carry the seam faces it
+        #   must price (the re-registered fine-below-the-floor faces at
+        #   interior bz-1 reach the wrap-adjacent device through its ring
+        #   row; same-level seam faces ride m_same's wrap the same way);
+        # * local mode constant-pads — its box-edge faces are placed
+        #   explicitly on ring row 0 below, and a wrap pad would copy
+        #   interior cross-face registrations onto the opposite ring row
+        #   as spurious faces, which the pooled wrap segments then deliver
+        #   as phantom fluxes into the far-side coarse cells.
+        cross_z_wrap = z_mask_wrap if slab_z else False
+        m_lowf = np.stack([
+            pad3(m_lowf_i[d], xy_wrap=False, z_wrap=cross_z_wrap)
+            for d in range(3)
+        ])
+        m_highf = np.stack([
+            pad3(m_highf_i[d], xy_wrap=False, z_wrap=cross_z_wrap)
+            for d in range(3)
+        ])
         for d, edge in edge_planes.items():
             ax = 2 - d
             sl = [slice(1, 1 + bz), slice(1, 1 + by), slice(1, 1 + bx)]
