@@ -166,3 +166,56 @@ def test_scatter_matches_loop_reference():
         exp_cnt[d, r] += 1
     _np.testing.assert_array_equal(cnt, exp_cnt)
     _np.testing.assert_allclose(pos, exp_pos)
+
+
+@pytest.mark.parametrize("seed", [2, 7])
+def test_fuzz_particles_random_grids(seed):
+    """Randomized PIC: random (possibly refined) grid and device count;
+    particle count conserved through pushes with migration, buckets stay
+    position-consistent, and the machinery survives AMR and a load
+    balance."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([4, 6, 8]))
+    n_dev = int(rng.choice([1, 2, 4, 8]))
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    if rng.random() < 0.5:
+        ids = g.get_cells()
+        for cid in rng.choice(ids, size=len(ids) // 6 + 1, replace=False):
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    npart = int(rng.integers(200, 1500))
+    m = Particles(g, max_particles_per_cell=256)
+    state = m.new_state(rng.random((npart, 3)))
+    vel = m.velocity_field(lambda c: 0.2 * (c - 0.5))
+    for turn in range(4):
+        state = m.step(state, velocity=vel, dt=0.1)
+        assert m.count(state) == npart
+    ids = g.get_cells()
+    for cell in rng.choice(ids, size=min(30, len(ids)), replace=False):
+        pts = m.particles_of(state, int(cell))
+        if len(pts):
+            lo = g.geometry.get_min(np.asarray([cell], np.uint64))[0]
+            hi = g.geometry.get_max(np.asarray([cell], np.uint64))[0]
+            assert ((pts >= lo - 1e-12) & (pts <= hi + 1e-12)).all()
+    for cid in rng.choice(ids, size=3, replace=False):
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    state = m.remap(state)
+    g.balance_load()
+    state = m.remap(state)
+    state = m.step(
+        state, velocity=m.velocity_field(lambda c: 0.2 * (c - 0.5)), dt=0.1
+    )
+    assert m.count(state) == npart
