@@ -152,6 +152,7 @@ class Grid:
             )
         self._halo_cache = {}
         self._id_pos_cache = None
+        self._unrefine_cache = None
 
     # --------------------------------------------------------- cell views
 
@@ -794,24 +795,74 @@ class Grid:
         # the reference's return values
         if not self.amr.to_unrefine.isdisjoint(siblings):
             return True
-        # parent's would-be neighborhood must not contain too-fine cells
-        from .amr.refinement import _find_for_nonleaves
-
-        parent = self.mapping.parent_of(cell)
-        plists = _find_for_nonleaves(
-            self.mapping, self.topology, self.leaves,
-            np.asarray([parent], dtype=np.uint64), self.neighborhoods[None],
+        # parent's would-be neighborhood must not contain too-fine cells;
+        # the neighbor structure is static per epoch, so it is computed
+        # ONCE for every candidate parent in one vectorized search and
+        # cached (only the to_refine membership check is per-call)
+        too_fine, same_lvl_nbrs = self._unrefine_parent_info(
+            self.mapping.parent_of(cell)
         )
-        pos = plists.nbr_pos
-        if (pos < 0).any():
+        if too_fine:
             return True  # no-op: neighbor more than one level finer
-        n_lvl = self.mapping.get_refinement_level(self.leaves.cells[pos])
-        p_lvl = lvl - 1
-        for n, nl in zip(self.leaves.cells[pos], n_lvl):
-            if nl == p_lvl + 1 and int(n) in self.amr.to_refine:
-                return True
+        if not self.amr.to_refine.isdisjoint(same_lvl_nbrs):
+            return True  # a would-be same-size neighbor is being refined
         self.amr.to_unrefine.add(cell)
         return True
+
+    def _unrefine_parent_info(self, parent: int):
+        """(too_fine, ids of the parent's would-be neighbors one level
+        finer than it) for a candidate parent.  Built per epoch with ONE
+        vectorized neighbor search over every candidate parent (the
+        per-family scalar search used to dominate unrefinement request
+        storms); the per-parent answer resolves lazily by searchsorted,
+        so no per-parent Python structures are materialized."""
+        cache = getattr(self, "_unrefine_cache", None)
+        if cache is None or cache[0] is not self.epoch:
+            from .amr.refinement import _find_for_nonleaves
+
+            lvl = self.mapping.get_refinement_level(self.leaves.cells)
+            finer = self.leaves.cells[lvl > 0]
+            parents = np.unique(self.mapping.get_parent(finer))
+            if len(parents):
+                plists = _find_for_nonleaves(
+                    self.mapping, self.topology, self.leaves,
+                    parents, self.neighborhoods[None],
+                )
+                p_lvl = self.mapping.get_refinement_level(parents)
+                counts = np.diff(plists.start)
+                src = np.repeat(np.arange(len(parents)), counts)
+                pos = plists.nbr_pos
+                neg = (pos < 0).astype(np.int64)
+                cum = np.concatenate(([0], np.cumsum(neg)))
+                too_fine_all = (
+                    cum[plists.start[1:]] - cum[plists.start[:-1]]
+                ) > 0
+                n_lvl = np.where(
+                    pos >= 0,
+                    self.mapping.get_refinement_level(
+                        self.leaves.cells[np.maximum(pos, 0)]
+                    ),
+                    -1,
+                )
+                fine_mask = n_lvl == p_lvl[src] + 1
+                fsrc = src[fine_mask]
+                fcells = self.leaves.cells[pos[fine_mask]]
+                fcounts = np.bincount(fsrc, minlength=len(parents))
+                fstart = np.concatenate(([0], np.cumsum(fcounts)))
+            else:
+                too_fine_all = np.zeros(0, dtype=bool)
+                fcells = np.zeros(0, dtype=np.uint64)
+                fstart = np.zeros(1, dtype=np.int64)
+            cache = (self.epoch, parents, too_fine_all, fcells, fstart)
+            self._unrefine_cache = cache
+        _, parents, too_fine_all, fcells, fstart = cache
+        i = int(np.searchsorted(parents, np.uint64(parent)))
+        if i >= len(parents) or parents[i] != np.uint64(parent):
+            return True, frozenset()
+        return (
+            bool(too_fine_all[i]),
+            set(fcells[fstart[i]:fstart[i + 1]].tolist()),
+        )
 
     def dont_refine(self, cell) -> bool:
         cell = int(cell)
