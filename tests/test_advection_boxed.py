@@ -425,3 +425,57 @@ def test_fuzz_paths_agree(seed):
         a = flat.run(s0, 3, dt)
         ra = np.asarray(flat.get_cell_data(a, "density", ids), np.float64)
         assert np.abs(ra - ref).max() / scale < 5e-6
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_fuzz_three_level_boxed(seed):
+    """Three-level grids (two cross-level pairs in the boxed layout):
+    random scattered refinement must match the general gather path."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([4, 6]))
+    n_dev = int(rng.choice([1, 2, 4]))
+    periodic = tuple(bool(b) for b in rng.integers(0, 2, 3))
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(*periodic)
+        .set_maximum_refinement_level(2)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    for frac in (0.3, 0.2):
+        ids = g.get_cells()
+        for cid in rng.choice(ids, size=max(1, int(frac * len(ids))),
+                              replace=False):
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    ids = g.get_cells()
+    if g.mapping.get_refinement_level(ids).max() < 2:
+        pytest.skip("refinement did not reach level 2")
+    adv = Advection(g, dtype=np.float32, use_pallas=False)
+    if getattr(adv, "_boxed_run", None) is None:
+        pytest.skip("boxed layout ineligible for this pattern")
+    s0 = adv.initialize_state()
+    s0 = adv.set_cell_data(
+        s0, "density", ids, rng.uniform(1, 2, len(ids)).astype(np.float32)
+    )
+    for f in ("vx", "vy", "vz"):
+        s0 = adv.set_cell_data(
+            s0, f, ids, rng.uniform(-0.3, 0.3, len(ids)).astype(np.float32)
+        )
+    s0 = g.update_copies_of_remote_neighbors(s0)
+    dt = np.float32(0.3 * adv.max_time_step(s0))
+    st = s0
+    for _ in range(3):
+        st = adv.step(st, dt)
+    ref = np.asarray(adv.get_cell_data(st, "density", ids), np.float64)
+    b = adv._boxed_run(s0, jnp.asarray(3, jnp.int32), dt)
+    rb = np.asarray(adv.get_cell_data(b, "density", ids), np.float64)
+    assert np.abs(rb - ref).max() / np.abs(ref).max() < 5e-6
