@@ -42,6 +42,8 @@ REFINED_N = 48          # 48^3 level-0, ball refined -> ~198k cells, 2 levels
 REFINED_STEPS = 2000
 LARGE = (512, 512, 128)  # f32 density alone is 128 MiB: cannot fit VMEM
 LARGE_STEPS = 200
+GOL_N = 500              # the reference example's board (game_of_life.cpp)
+GOL_TURNS = 20000
 
 
 #: HBM peak bandwidth per chip generation (GB/s), for roofline fractions
@@ -216,6 +218,41 @@ def measure_large() -> dict:
         "achieved_HBM_GBps": round(achieved, 1),
         "hbm_peak_GBps": peak,
         "hbm_fraction_of_peak": round(achieved / peak, 3) if peak else None,
+    }
+
+
+def measure_gol() -> dict:
+    """BASELINE.md config 1: the reference's hello-world —
+    examples/game_of_life.cpp's 500x500 board with the length-1 vertex
+    neighborhood — on the fused whole-run GoL kernel (ops/gol_kernel.py).
+    Reports cell-updates/s vs the C++ CPU denominator
+    (tools/cpu_gol_baseline.cpp)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import Grid, make_mesh
+    from dccrg_tpu.models import GameOfLife
+
+    n = GOL_N
+    g = (
+        Grid()
+        .set_initial_length((n, n, 1))
+        .set_neighborhood_length(1)
+        .initialize(mesh=make_mesh())
+    )
+    rng = np.random.default_rng(0)
+    cells = g.get_cells()
+    alive0 = cells[rng.random(len(cells)) < 0.3]
+    gol = GameOfLife(g)
+    state = gol.new_state(alive_cells=alive0)
+    jax.block_until_ready(gol.run(state, 2))
+    secs, times, _ = _median_of(lambda: gol.run(state, GOL_TURNS), n=5)
+    return {
+        "grid": [n, n],
+        "turns": GOL_TURNS,
+        "fused_kernel": gol._dense_run is not None,
+        "updates_per_s": n * n * GOL_TURNS / secs,
+        "times_s": [round(t, 4) for t in times],
     }
 
 
@@ -405,22 +442,21 @@ print("BENCH_JSON:" + json.dumps(r8))
     return None
 
 
-def measure_cpu_baseline() -> float:
-    """Build + run the C++ CPU denominator; cached in BASELINE_LOCAL.json."""
+def _cpu_denominator(key: str, src_name: str, argv: list) -> float:
+    """Build + run a C++ CPU denominator; cached in BASELINE_LOCAL.json."""
     cache = ROOT / "BASELINE_LOCAL.json"
-    key = f"advection_{NX}x{NY}x{NZ}"
     if cache.exists():
         data = json.loads(cache.read_text())
         if key in data:
             return data[key]
-    exe = ROOT / "tools" / "cpu_baseline"
-    src = ROOT / "tools" / "cpu_baseline.cpp"
+    exe = ROOT / "tools" / src_name
+    src = ROOT / "tools" / (src_name + ".cpp")
     subprocess.run(
         ["g++", "-O3", "-march=native", "-fopenmp", "-o", str(exe), str(src)],
         check=True,
     )
     out = subprocess.run(
-        [str(exe), str(NX), str(NY), str(NZ), "10"],
+        [str(exe)] + [str(a) for a in argv],
         check=True,
         capture_output=True,
         text=True,
@@ -430,6 +466,18 @@ def measure_cpu_baseline() -> float:
     data[key] = value
     cache.write_text(json.dumps(data, indent=1))
     return value
+
+
+def measure_cpu_baseline() -> float:
+    return _cpu_denominator(
+        f"advection_{NX}x{NY}x{NZ}", "cpu_baseline", [NX, NY, NZ, 10]
+    )
+
+
+def measure_cpu_gol_baseline() -> float:
+    return _cpu_denominator(
+        f"gol_{GOL_N}x{GOL_N}", "cpu_gol_baseline", [GOL_N, GOL_N, 200]
+    )
 
 
 #: wall-clock ceiling for the real measurement child process; the full
@@ -516,6 +564,7 @@ def _main_real():
     tpu = measure_tpu()
     extras = {}
     for name, fn in (("refined", measure_refined), ("large", measure_large),
+                     ("gol", measure_gol),
                      ("poisson", measure_poisson), ("vlasov", measure_vlasov),
                      ("multidev_cpu", measure_multidev_cpu)):
         try:
@@ -585,6 +634,24 @@ def _main_real():
                 k: (round(v, 1) if isinstance(v, float) else v)
                 for k, v in extras[name].items()
             }
+    if extras.get("gol"):
+        gl = extras["gol"]
+        try:
+            gol_cpu = measure_cpu_gol_baseline()
+        except Exception as e:  # noqa: BLE001
+            print(f"gol cpu baseline failed: {e}", file=sys.stderr)
+            gol_cpu = None
+        detail["gol"] = {
+            "grid": gl["grid"],
+            "turns": gl["turns"],
+            "fused_kernel": gl["fused_kernel"],
+            "updates_per_s": round(gl["updates_per_s"], 1),
+            "cpu_baseline_updates_per_s": gol_cpu,
+            "vs_baseline": (
+                round(gl["updates_per_s"] / gol_cpu, 3) if gol_cpu else -1
+            ),
+            "times_s": gl.get("times_s"),
+        }
     if extras.get("multidev_cpu"):
         detail["multidev_cpu"] = {
             k: (round(v, 6) if isinstance(v, float) else v)

@@ -71,6 +71,23 @@ class Advection:
             # boxed layout (e.g. wrap-adjacent refinement is gated out of
             # slab-mode boxed but handled exactly by the flat rolls)
             self._flat_run = self._build_flat_run()
+            # cost-based choice when both fast paths qualify: measured
+            # on-chip (TPU v5e), the flat kernel retires ~2x the voxel
+            # updates/s of the boxed per-level passes, so its 8x-inflated
+            # voxel grid only wins while it stays under ~2x the summed
+            # boxed box volumes.  Only the compiled single-device Pallas
+            # branch is calibrated — interpret mode (tests) and the
+            # sharded XLA form keep the flat preference so the flat
+            # numerics stay exercised
+            if (
+                self._flat_kind == "pallas"
+                and self._flat_run is not None
+                and self.boxed is not None
+            ):
+                boxed_vol = sum(
+                    int(np.prod(b.shape)) for b in self.boxed.boxes.values()
+                )
+                self._prefer_boxed = self._flat_n_vox > 2.0 * boxed_vol
 
     # ------------------------------------------------------ static tables
 
@@ -237,6 +254,7 @@ class Advection:
 
         # use_pallas doubles as the fast-path opt-out: False always means
         # the reference boxed numerics
+        self._flat_kind = None
         if not self.use_pallas:
             return None
 
@@ -248,6 +266,8 @@ class Advection:
                 if np.dtype(self.dtype) == np.float32
                 else jnp.float64
             )
+            self._flat_n_vox = int(np.prod(ts["shape"])) * ts["n_devices"]
+            self._flat_kind = "sharded"
             return make_flat_amr_run_sharded(self.grid, ts, dtype=jdt)
 
         interpret = self.use_pallas == "interpret"
@@ -261,6 +281,8 @@ class Advection:
         if t is None:
             return None
         nz1, ny1, nx1 = t["shape"]
+        self._flat_n_vox = nz1 * ny1 * nx1
+        self._flat_kind = "pallas_interpret" if interpret else "pallas"
         kernel = make_flat_amr_run(nz1, ny1, nx1, interpret=interpret)
         rows = jnp.asarray(t["rows"])
         leaf = t["leaf_fine"]
@@ -700,6 +722,13 @@ class Advection:
         interleaved with host logic (AMR, load balancing, IO)."""
         if getattr(self, "_fused_run", None) is not None:
             return self._fused_run(
+                state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
+            )
+        if (
+            getattr(self, "_prefer_boxed", False)
+            and getattr(self, "_boxed_run", None) is not None
+        ):
+            return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if getattr(self, "_flat_run", None) is not None:

@@ -174,7 +174,10 @@ def compute_flat_weights(tables, VX, VY, VZ, dtype=jnp.float32):
 
     For each axis d the face above voxel p pairs (p, p+e_d).  Returns
     ``(wp, wn)`` per axis with ``F = V*wp + roll(V,-1,ax)*wn`` the signed
-    outgoing flux (no dt; the kernel multiplies dt into the update)."""
+    outgoing flux (no dt; both consumers — make_flat_amr_run's wrapper
+    and the sharded XLA body — premultiply dt into these weight arrays,
+    the shared association that keeps the two forms rounding
+    identically)."""
     nz1, ny1, nx1 = tables["shape"]
     leaf = jnp.asarray(tables["leaf_fine"])
     area = tables["area_f"]
@@ -202,29 +205,35 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
     same shell as ``make_fused_run``).
 
     ``upd_f = leaf_fine/vol_f`` and ``upd_c = (~leaf_fine)/vol_c`` fold
-    the level-dependent volume division into per-voxel constants."""
+    the level-dependent volume division into per-voxel constants; the run
+    wrapper premultiplies ``dt`` into the six face-weight arrays outside
+    the kernel (``dt*v_face*area`` is the per-face swept volume — the
+    same order of magnitude as the cell volume under CFL, so the
+    premultiply never drives intermediates toward the f32 subnormal
+    range the way scaling the ~1/vol update constants would).
+
+    VMEM discipline: weight/mask refs are read inside the step body (the
+    reads are transient stack temporaries the allocator reuses) rather
+    than hoisted into loop-carried copies — hoisting all six weight
+    arrays pushed the scoped-VMEM stack past the 96 MiB default on a
+    96^3 voxel grid and forced spills."""
     roll_m1, roll_p1 = _make_rolls(interpret)
 
-    def kernel(dt_ref, steps_ref, v_ref, wpx, wnx, wpy, wny, wpz, wnz,
+    def kernel(steps_ref, v_ref, wpx, wnx, wpy, wny, wpz, wnz,
                updf_ref, updc_ref, out_ref, scr_ref):
-        dt = dt_ref[0]
         steps = steps_ref[0]
-        cwpx, cwnx = wpx[...], wnx[...]
-        cwpy, cwny = wpy[...], wny[...]
-        cwpz, cwnz = wpz[...], wnz[...]
-        updf, updc = updf_ref[...], updc_ref[...]
-        # pool mask = coarse voxels; fold it into updc's support: the
-        # roll-chain pool below must only sum coarse deltas, so mask with
-        # (updc != 0) — exact since updc is 0 or 1/vol_c
-        pool = (updc != 0).astype(cwpx.dtype)
+        # pool mask = coarse voxels; the roll-chain pool below must only
+        # sum coarse deltas, so mask with (updc != 0) — exact since updc
+        # is 0 or 1/vol_c
+        pool = (updc_ref[...] != 0).astype(jnp.float32)
 
         def one_step(src_ref, dst_ref):
             v = src_ref[...]
-            fx = v * cwpx + roll_m1(v, 2) * cwnx
-            fy = v * cwpy + roll_m1(v, 1) * cwny
-            fz = v * cwpz + roll_m1(v, 0) * cwnz
+            fx = v * wpx[...] + roll_m1(v, 2) * wnx[...]
             delta = roll_p1(fx, 2) - fx
+            fy = v * wpy[...] + roll_m1(v, 1) * wny[...]
             delta = delta + roll_p1(fy, 1) - fy
+            fz = v * wpz[...] + roll_m1(v, 0) * wnz[...]
             delta = delta + roll_p1(fz, 0) - fz
             # 2x2x2 block sum of coarse deltas at block origins: blocks
             # are even-aligned, so the -1-roll chain puts sum_{e in
@@ -242,13 +251,13 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
             s = s + roll_p1(s, 2)
             s = s + roll_p1(s, 1)
             s = s + roll_p1(s, 0)
-            dst_ref[...] = v + dt * (delta * updf + s * updc)
+            dst_ref[...] = v + delta * updf_ref[...] + s * updc_ref[...]
 
         # origin parity mask, built once from iota (static shapes)
         ex = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 2) % 2 == 0
         ey = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 1) % 2 == 0
         ez = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 0) % 2 == 0
-        orig = (ex & ey & ez).astype(cwpx.dtype)
+        orig = (ex & ey & ez).astype(jnp.float32)
 
         out_ref[...] = v_ref[...]
 
@@ -280,7 +289,7 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
         )
     call = pl.pallas_call(
         kernel,
-        in_specs=[smem, smem] + [vmem] * 9,
+        in_specs=[smem] + [vmem] * 9,
         out_specs=vmem,
         scratch_shapes=[pltpu.VMEM((nz1, ny1, nx1), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((nz1, ny1, nx1), jnp.float32),
@@ -289,10 +298,10 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
     )
 
     def run(V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c, dt, steps):
-        dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
+        dt = jnp.asarray(dt, jnp.float32)
         steps_arr = jnp.asarray(steps, jnp.int32).reshape(1)
-        return call(dt_arr, steps_arr, V, wpx, wnx, wpy, wny, wpz, wnz,
-                    upd_f, upd_c)
+        return call(steps_arr, V, wpx * dt, wnx * dt, wpy * dt, wny * dt,
+                    wpz * dt, wnz * dt, upd_f, upd_c)
 
     return run
 
@@ -470,6 +479,14 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
             gface, area[2], dtype, extra_z,
         )
 
+        # premultiply dt into the face weights — the same association the
+        # single-device Pallas wrapper uses, so both forms round
+        # identically step for step
+        dtc = jnp.asarray(dt, dtype)
+        wpx, wnx = wpx * dtc, wnx * dtc
+        wpy, wny = wpy * dtc, wny * dtc
+        wzp, wzn = wzp * dtc, wzn * dtc
+
         # ---- static update masks
         updf = leaf.astype(dtype) * inv_vf
         pool = (~leaf).astype(dtype)
@@ -496,7 +513,7 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
             s = s + jnp.roll(s, 1, 2)
             s = s + jnp.roll(s, 1, 1)
             s = s + jnp.roll(s, 1, 0)
-            return Vc + dt * (delta * updf + s * updc)
+            return Vc + (delta * updf + s * updc)
 
         out = jax.lax.fori_loop(0, steps, one, V)
         rho = jnp.where(wbv, out.reshape(-1)[wbr], rho_rows[0])

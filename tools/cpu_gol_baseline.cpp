@@ -1,0 +1,81 @@
+// CPU baseline for the game-of-life benchmark: the reference's hello-world
+// workload (examples/game_of_life.cpp — 2-D board, length-1 vertex
+// neighborhood, live-neighbor count then 2/3 rule) with the reference's
+// compute pattern: AoS cells holding {is_alive, live_neighbor_count}
+// (examples/simple_game_of_life.cpp:36-44) and neighbor access through an
+// index indirection list (the neighbors_of iteration), multi-threaded over
+// all host cores.
+//
+// The actual reference (dccrg + MPI + Zoltan) cannot be built in this image
+// (no MPI/boost/Zoltan); this program re-creates its compute pattern as the
+// honest MPI-CPU denominator for BASELINE.md's protocol, exactly like
+// tools/cpu_baseline.cpp does for advection.
+//
+// Usage: cpu_gol_baseline NX NY TURNS  -> prints cell-updates/sec
+#include <cstdio>
+#include <cstdlib>
+#include <cstdint>
+#include <chrono>
+#include <vector>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+struct Cell {
+    uint64_t data[2]; // is_alive, live_neighbor_count
+};
+
+int main(int argc, char** argv) {
+    const int64_t nx = argc > 1 ? atoll(argv[1]) : 500;
+    const int64_t ny = argc > 2 ? atoll(argv[2]) : 500;
+    const int64_t turns = argc > 3 ? atoll(argv[3]) : 100;
+    const int64_t n = nx * ny;
+
+    std::vector<Cell> cells(n);
+    // 8-neighbor indirection (open boundaries: -1 = missing neighbor,
+    // the reference's error_cell skip)
+    std::vector<int64_t> nbr(n * 8);
+    uint64_t seed = 0x9e3779b97f4a7c15ull;
+    for (int64_t y = 0; y < ny; y++)
+    for (int64_t x = 0; x < nx; x++) {
+        const int64_t i = x + nx * y;
+        // xorshift: ~30% initial fill, deterministic
+        seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
+        cells[i].data[0] = (seed % 100) < 30 ? 1 : 0;
+        cells[i].data[1] = 0;
+        int k = 0;
+        for (int dy = -1; dy <= 1; dy++)
+        for (int dx = -1; dx <= 1; dx++) {
+            if (!dx && !dy) continue;
+            const int64_t xx = x + dx, yy = y + dy;
+            nbr[i * 8 + k++] =
+                (xx < 0 || xx >= nx || yy < 0 || yy >= ny)
+                    ? -1 : xx + nx * yy;
+        }
+    }
+
+    const auto t0 = std::chrono::high_resolution_clock::now();
+    for (int64_t t = 0; t < turns; t++) {
+#pragma omp parallel for schedule(static)
+        for (int64_t i = 0; i < n; i++) {
+            uint64_t cnt = 0;
+            for (int k = 0; k < 8; k++) {
+                const int64_t j = nbr[i * 8 + k];
+                if (j >= 0) cnt += cells[j].data[0];
+            }
+            cells[i].data[1] = cnt;
+        }
+#pragma omp parallel for schedule(static)
+        for (int64_t i = 0; i < n; i++) {
+            const uint64_t cnt = cells[i].data[1];
+            if (cnt == 3) cells[i].data[0] = 1;
+            else if (cnt != 2) cells[i].data[0] = 0;
+        }
+    }
+    const auto t1 = std::chrono::high_resolution_clock::now();
+    const double secs = std::chrono::duration<double>(t1 - t0).count();
+    volatile uint64_t sink = cells[n / 2].data[0];
+    (void)sink;
+    printf("%.6e\n", double(n) * turns / secs);
+    return 0;
+}
