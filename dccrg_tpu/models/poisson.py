@@ -45,7 +45,7 @@ class Poisson:
     SKIP_CELL = 2
 
     def __init__(self, grid, hood_id=None, dtype=np.float64,
-                 solve_cells=None, skip_cells=None):
+                 solve_cells=None, skip_cells=None, allow_flat=True):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
@@ -55,7 +55,31 @@ class Poisson:
         self._full_solve = solve_cells is None
         self._build_cell_types(solve_cells, skip_cells)
         self._build_factors()
+        self._flat = self._build_flat() if allow_flat else None
         self._solve = self._build_solver()
+
+    def _build_flat(self):
+        """Dense flat-voxel operator (ops/flat_poisson.py) — engaged when
+        the grid qualifies (single device, Cartesian, levels ⊆ {0, 1});
+        the gather tables remain the general path and the oracle."""
+        from ..ops.flat_poisson import (
+            build_flat_poisson,
+            make_flat_poisson_apply,
+        )
+
+        t = build_flat_poisson(
+            self.grid,
+            self._f_pos_leaf,
+            self._f_neg_leaf,
+            self._scaling_leaf,
+            self._cell_type_leaf,
+            self.SOLVE_CELL,
+            self.SKIP_CELL,
+            self.BOUNDARY_CELL,
+        )
+        if t is None:
+            return None
+        return make_flat_poisson_apply(t, jnp.dtype(self.dtype))
 
     def _build_cell_types(self, solve_cells, skip_cells):
         """Per-leaf role array (reference cache_system_info,
@@ -191,8 +215,12 @@ class Poisson:
             jnp.asarray(a, self.dtype), shard_spec(self.grid.mesh, np.ndim(a))
         )
         self._scaling = put(scaling_rows)
-        self._mult_fwd = put(mult_fwd)
-        self._mult_rev = put(mult_rev)
+        # the [D, R, K] multiplier tables are only uploaded when the
+        # gather path actually runs (solver fallback or residual()); when
+        # the flat fast path engages they would otherwise pin
+        # O(R*K) * 2 device memory as a diagnostics-only oracle
+        self._mult_np = (mult_fwd, mult_rev)
+        self._mult_dev = None
         self._volume = put(np.asarray(self.tables.length).prod(-1))
         solve_rows = np.asarray(self.tables.local_mask) & (
             type_rows == self.SOLVE_CELL
@@ -200,8 +228,26 @@ class Poisson:
         self._solve_mask = jax.device_put(
             jnp.asarray(solve_rows), shard_spec(self.grid.mesh, 2)
         )
+        # leaf-level factors kept for the flat dense fast path
+        # (ops/flat_poisson.py): per-(leaf, axis) side factors + diagonal
+        self._f_pos_leaf = f_pos
+        self._f_neg_leaf = f_neg
+        self._scaling_leaf = scaling_leaf
 
     # ----------------------------------------------------------- solver
+
+    def _mult_tables(self):
+        """Device copies of the [D, R, K] fwd/rev multiplier tables,
+        uploaded on first gather-path use."""
+        if self._mult_dev is None:
+            from ..parallel.mesh import shard_spec
+
+            put = lambda a: jax.device_put(
+                jnp.asarray(a, self.dtype), shard_spec(self.grid.mesh, 3)
+            )
+            self._mult_dev = tuple(put(a) for a in self._mult_np)
+            self._mult_np = None  # host copies served their purpose
+        return self._mult_dev
 
     def _apply(self, x, mult):
         """A·x (or Aᵀ·x with the transpose table): ghost-refresh then
@@ -211,21 +257,36 @@ class Poisson:
         return self._scaling * x + ordered_sum(mult * xn, axis=-1), x
 
     def _build_solver(self):
+        """The BiCG loop, built over one of two operator spaces: the
+        general gather tables ([1, R] rows) or the flat voxel grid when
+        it qualifies — same algorithm, same stopping rules."""
         local = self.tables.local_mask
-        solve_mask = self._solve_mask
-        mult_fwd, mult_rev = self._mult_fwd, self._mult_rev
+        if self._flat is not None:
+            apply_fwd, apply_rev, voxelize, writeback, masks = self._flat
+            solve_mask = masks["solve"]
+            dot_mask = masks["dot"]
+            lift = voxelize
+            project = writeback
+        else:
+            solve_mask = self._solve_mask
+            dot_mask = solve_mask
+            mult_fwd, mult_rev = self._mult_tables()
+            apply_fwd = lambda v: self._apply(v, mult_fwd)[0]
+            apply_rev = lambda v: self._apply(v, mult_rev)[0]
+            # boundary cells keep their given solution values: they feed
+            # the initial residual (Dirichlet lifting) but never change
+            lift = lambda row_arr: jnp.where(local, row_arr, 0.0)
+            project = lambda v: v
 
         def dot(a, b):
-            return jnp.sum(jnp.where(solve_mask, a * b, 0.0))
+            return jnp.sum(jnp.where(dot_mask, a * b, 0.0))
 
         @jax.jit
         def solve(state, max_iterations, stop_residual, stop_after_increase):
-            rhs = jnp.where(solve_mask, state["rhs"], 0.0)
-            # boundary cells keep their given solution values: they feed
-            # the initial residual (Dirichlet lifting) but never change
-            x = jnp.where(local, state["solution"], 0.0)
+            rhs = jnp.where(solve_mask, lift(state["rhs"]), 0.0)
+            x = lift(state["solution"])
 
-            Ax, _ = self._apply(x, mult_fwd)
+            Ax = apply_fwd(x)
             r0 = jnp.where(solve_mask, rhs - Ax, 0.0)
             r1 = r0
             p0, p1 = r0, r1
@@ -251,10 +312,8 @@ class Poisson:
                 # are local and never ghost-refreshed, so unmasked values
                 # would leak into r and p (reference updates SOLVE cells
                 # only, poisson_solve.hpp:405-520)
-                Ap0, _ = self._apply(p0, mult_fwd)
-                Ap0 = jnp.where(solve_mask, Ap0, 0.0)
-                ATp1, _ = self._apply(p1, mult_rev)
-                ATp1 = jnp.where(solve_mask, ATp1, 0.0)
+                Ap0 = jnp.where(solve_mask, apply_fwd(p0), 0.0)
+                ATp1 = jnp.where(solve_mask, apply_rev(p1), 0.0)
                 dot_p = dot(p1, Ap0)
                 alpha = jnp.where(dot_p != 0, dot_r / dot_p, 0.0)
                 x = x + alpha * p0
@@ -274,7 +333,8 @@ class Poisson:
             i, x, r0, r1, p0, p1, dot_r, res, best_res, best_x = jax.lax.while_loop(
                 cond, body, carry
             )
-            return {**state, "solution": jnp.where(local, best_x, 0.0)}, best_res, i
+            sol = jnp.where(local, project(best_x), 0.0)
+            return {**state, "solution": sol}, best_res, i
 
         return solve
 
@@ -309,6 +369,6 @@ class Poisson:
         return state, float(res), int(it)
 
     def residual(self, state) -> float:
-        Ax, _ = self._apply(state["solution"], self._mult_fwd)
+        Ax, _ = self._apply(state["solution"], self._mult_tables()[0])
         r = np.asarray(jnp.where(self._solve_mask, state["rhs"] - Ax, 0.0))
         return float(np.sqrt((r * r).sum()))

@@ -54,6 +54,7 @@ __all__ = [
     "build_flat_amr_tables",
     "make_flat_amr_run",
     "flat_amr_fits",
+    "flat_voxel_layout",
     "build_flat_amr_sharded",
     "make_flat_amr_run_sharded",
 ]
@@ -68,20 +69,19 @@ def flat_amr_fits(n_voxels: int) -> bool:
     return _FLAT_ARRAYS * n_voxels * 4 <= _FLAT_VMEM_BUDGET
 
 
-def build_flat_amr_tables(grid):
-    """Static tables for the flat layout, or None if the grid does not
-    qualify (single device, Cartesian, leaves at levels {0, 1} with some
-    refinement, VMEM fit).
+def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None):
+    """The shared single-device flat voxel layout, or None if the grid
+    does not qualify (single device, Cartesian, leaf levels ⊆ {0, 1}).
 
     Returns a dict:
-      shape        (nz1, ny1, nx1) voxel grid at level-1 resolution
+      shape        (nzv, nyv, nxv) voxel grid at max-leaf-level resolution
+      vox_level    0 (uniform) or 1
       rows         (n_vox,) int32 epoch row per voxel (coarse replicated)
-      leaf_fine    (nz1, ny1, nx1) bool — voxel is a level-1 leaf
+      leaf_fine    (nzv, nyv, nxv) bool — voxel is a max-level leaf
       wb_rows      (R,) int32 — for each epoch row, a representative flat
                    voxel (fine: its voxel; coarse: block origin); scratch
                    and invalid rows point at voxel 0
       wb_valid     (R,) bool
-      area_f, vol_f, vol_c, periodic
     """
     from ..geometry.cartesian import CartesianGeometry
     from ..geometry.stretched import StretchedCartesianGeometry
@@ -99,29 +99,31 @@ def build_flat_amr_tables(grid):
     if N == 0:
         return None
     lvl = mapping.get_refinement_level(leaves.cells).astype(np.int64)
-    if lvl.max() != 1 or lvl.min() != 0:
-        return None  # dense path (uniform) or deeper hierarchy (boxed)
+    vl = int(lvl.max())
+    if vl > 1 or (vl == 0 and not allow_uniform):
+        return None
     L = mapping.max_refinement_level
-    nx1, ny1, nz1 = (int(v) << 1 for v in mapping.length)
-    n_vox = nx1 * ny1 * nz1
-    if not flat_amr_fits(n_vox):
+    nxv, nyv, nzv = (int(v) << vl for v in mapping.length)
+    n_vox = nxv * nyv * nzv
+    if max_voxels is not None and n_vox > max_voxels:
         return None
 
     idx = mapping.get_indices(leaves.cells).astype(np.int64)  # (N,3) x,y,z
-    vox = idx >> (L - 1)                       # level-1-resolution origin
-    flat0 = (vox[:, 2] * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
+    vox = idx >> (L - vl)                # voxel-resolution origin
+    flat0 = (vox[:, 2] * nyv + vox[:, 1]) * nxv + vox[:, 0]
 
     rows = np.zeros(n_vox, dtype=np.int32)
     leaf_fine = np.zeros(n_vox, dtype=bool)
-    fine = lvl == 1
+    fine = lvl == vl
     rows[flat0[fine]] = epoch.row_of[fine]
     leaf_fine[flat0[fine]] = True
     coarse = np.flatnonzero(~fine)
-    for dz in range(2):
-        for dy in range(2):
-            for dx in range(2):
-                off = (dz * ny1 + dy) * nx1 + dx
-                rows[flat0[coarse] + off] = epoch.row_of[coarse]
+    if len(coarse):
+        for dz in range(2):
+            for dy in range(2):
+                for dx in range(2):
+                    off = (dz * nyv + dy) * nxv + dx
+                    rows[flat0[coarse] + off] = epoch.row_of[coarse]
 
     R = epoch.R
     wb_rows = np.zeros(R, dtype=np.int32)
@@ -129,13 +131,36 @@ def build_flat_amr_tables(grid):
     wb_rows[epoch.row_of] = flat0
     wb_valid[epoch.row_of] = True
 
-    l1 = np.asarray(grid.geometry.get_level_0_cell_length(), np.float64) / 2.0
     return dict(
-        shape=(nz1, ny1, nx1),
+        shape=(nzv, nyv, nxv),
+        vox_level=vl,
         rows=rows,
-        leaf_fine=leaf_fine.reshape(nz1, ny1, nx1),
+        leaf_fine=leaf_fine.reshape(nzv, nyv, nxv),
         wb_rows=wb_rows,
         wb_valid=wb_valid,
+    )
+
+
+def build_flat_amr_tables(grid):
+    """Static tables for the flat advection layout, or None if the grid
+    does not qualify (the shared layout's rules, plus: some refinement —
+    uniform grids take the dense path — and VMEM fit).
+
+    Adds to :func:`flat_voxel_layout`: area_f, vol_f, vol_c, periodic.
+    """
+    lay = flat_voxel_layout(
+        grid,
+        allow_uniform=False,
+        max_voxels=_FLAT_VMEM_BUDGET // (_FLAT_ARRAYS * 4),
+    )
+    if lay is None:
+        return None
+    if lay["leaf_fine"].all():
+        return None  # every leaf refined: no coarse level, boxed handles it
+
+    l1 = np.asarray(grid.geometry.get_level_0_cell_length(), np.float64) / 2.0
+    return dict(
+        lay,
         area_f=np.array([l1[1] * l1[2], l1[0] * l1[2], l1[0] * l1[1]]),
         vol_f=float(l1.prod()),
         vol_c=float(l1.prod() * 8.0),

@@ -1,0 +1,203 @@
+"""Dense flat-voxel Poisson matvec — the TPU fast path for the BiCG
+solver on uniform and two-level AMR grids.
+
+The general Poisson path applies A (and Aᵀ) through per-row gather tables
+(models/poisson.py), which lowers to XLA gathers — on TPU those retire
+roughly one element per cycle, so a ~50k-cell refined system costs ~ms per
+iteration.  This module re-expresses the matvec on the flat inflated voxel
+grid (the layout of ops/flat_amr.py: every leaf either is a fine voxel or
+is replicated over its 2x2x2 fine block), where neighbor access is six
+array rolls and coarse-row accumulation is the even-parity pool/broadcast
+roll chain — no gathers anywhere.
+
+Semantics reproduced exactly (reference ``tests/poisson/poisson_solve.hpp``):
+
+* per-face factors ``f_side`` from cell-center offsets with missing /
+  inactive neighbors giving 0 (``poisson_solve.hpp:691-822``) — taken
+  from the leaf-level arrays the model already computes;
+* a finer face neighbor's contribution divided by 4
+  (``poisson_solve.hpp:332-336``) — on the voxel grid this is uniform:
+  every face of a COARSE leaf spans 4 voxel sub-faces, so its per-voxel
+  weight is ``f/4`` and the pooled block sum restores ``f`` (same-level)
+  or ``f/4 * sum(fine values)`` (finer neighbor) exactly;
+* skip cells act as missing neighbors and boundary-boundary pairs are
+  dropped (``poisson_solve.hpp:896-965``) — folded into the per-voxel
+  face weights;
+* the transpose multiplier table (``poisson_solve.hpp:405-520``) needs no
+  second weight set here: with ``A = S·C·E`` (E = replicate leaves onto
+  voxels, S = Eᵀ = block sum, C = the voxel face operator), ``Aᵀ =
+  S·Cᵀ·E`` and ``Cᵀ`` is the same six weights applied with reversed
+  rolls.
+
+Qualifies: single device, (possibly degenerate) Cartesian geometry,
+leaf levels ⊆ {0, 1}.  The gather path remains the general fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["build_flat_poisson", "make_flat_poisson_apply"]
+
+#: HBM-side cap: the solver keeps ~10 voxel-resolution arrays alive
+_MAX_VOXELS = 1 << 24
+
+
+def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
+                       solve_code, skip_code, boundary_code):
+    """Static tables for the flat Poisson operator, or None if the grid
+    does not qualify.
+
+    ``f_pos``/``f_neg``: (N, 3) per-leaf per-axis side factors;
+    ``scaling_leaf``: (N,) diagonal; ``types_leaf``: (N,) cell roles.
+    """
+    from .flat_amr import flat_voxel_layout
+
+    lay = flat_voxel_layout(grid, allow_uniform=True, max_voxels=_MAX_VOXELS)
+    if lay is None:
+        return None
+    shape = lay["shape"]
+    rows = lay["rows"]
+    row_of = grid.epoch.row_of
+    R = grid.epoch.R
+
+    # leaf arrays -> row-indexed -> voxel-indexed
+    def to_vox(leaf_arr, fill=0):
+        rshape = (R,) + np.shape(leaf_arr)[1:]
+        row_arr = np.full(rshape, fill, dtype=np.asarray(leaf_arr).dtype)
+        row_arr[row_of] = leaf_arr
+        return row_arr[rows]
+
+    t_vox = to_vox(np.asarray(types_leaf), fill=skip_code)
+    f_pos_vox = to_vox(np.asarray(f_pos))          # (n_vox, 3)
+    f_neg_vox = to_vox(np.asarray(f_neg))
+    scaling_vox = to_vox(np.asarray(scaling_leaf))
+
+    nz1, ny1, nx1 = shape
+    rows3 = rows.reshape(shape)
+    fine3 = lay["leaf_fine"]
+    t3 = t_vox.reshape(shape)
+    sub = np.where(fine3, 1.0, 0.25)   # coarse faces span 4 voxel sub-faces
+
+    def active(ta, tb):
+        return (
+            (ta != skip_code)
+            & (tb != skip_code)
+            & ~((ta == boundary_code) & (tb == boundary_code))
+        )
+
+    weights = []
+    for d, ax in ((0, 2), (1, 1), (2, 0)):
+        fp = f_pos_vox[:, d].reshape(shape)
+        fn = f_neg_vox[:, d].reshape(shape)
+        rb_p = np.roll(rows3, -1, ax)
+        rb_n = np.roll(rows3, 1, ax)
+        # same-row faces are interior to a coarse block (no leaf face
+        # there) and must drop — EXCEPT when the roll wrapped around a
+        # periodic axis back into the same leaf (domain extent of one
+        # leaf along the axis): that is the leaf's genuine periodic face
+        # and the reference couples the cell to itself through it.
+        # Non-periodic domain edges are harmless to keep: their factors
+        # are already 0.
+        pos = np.arange(shape[ax])
+        at_max = (pos == shape[ax] - 1).reshape(
+            [-1 if a == ax else 1 for a in range(3)]
+        )
+        at_min = (pos == 0).reshape(
+            [-1 if a == ax else 1 for a in range(3)]
+        )
+        wp = fp * sub * active(t3, np.roll(t3, -1, ax)) * (
+            (rows3 != rb_p) | at_max
+        )
+        wn = fn * sub * active(t3, np.roll(t3, 1, ax)) * (
+            (rows3 != rb_n) | at_min
+        )
+        weights.append((wp, wn))
+
+    ex = (np.arange(nx1) % 2 == 0)[None, None, :]
+    ey = (np.arange(ny1) % 2 == 0)[None, :, None]
+    ez = (np.arange(nz1) % 2 == 0)[:, None, None]
+    orig = ex & ey & ez
+    solve3 = t3 == solve_code
+
+    return dict(
+        shape=shape,
+        rows=rows,
+        fine=fine3,
+        has_coarse=bool((~fine3).any()),
+        weights=weights,
+        scaling=scaling_vox.reshape(shape),
+        solve=solve3,
+        # dot weights: each leaf counted once (fine voxel, or the coarse
+        # block's even-parity origin)
+        dot_mask=solve3 & (fine3 | orig),
+        orig=orig,
+        wb_rows=lay["wb_rows"],
+        wb_valid=lay["wb_valid"],
+    )
+
+
+def make_flat_poisson_apply(tables, dtype):
+    """Returns ``(apply_fwd, apply_rev, voxelize, writeback, masks)``.
+
+    ``apply_*`` map a voxel array to A·v / Aᵀ·v in voxel layout (coarse
+    rows' results replicated over their blocks).  ``voxelize`` lifts a
+    ``[1, R]`` row array onto the voxel grid; ``writeback`` projects a
+    voxel array onto ``[1, R]`` rows.
+    """
+    shape = tables["shape"]
+    rows = jnp.asarray(tables["rows"])
+    fine_f = jnp.asarray(tables["fine"], dtype)
+    coarse_f = jnp.asarray(~tables["fine"], dtype)
+    orig_f = jnp.asarray(tables["orig"], dtype)
+    scaling = jnp.asarray(tables["scaling"], dtype)
+    W = [
+        (jnp.asarray(wp, dtype), jnp.asarray(wn, dtype))
+        for wp, wn in tables["weights"]
+    ]
+    has_coarse = tables["has_coarse"]
+    wb_rows = jnp.asarray(tables["wb_rows"])
+    wb_valid = jnp.asarray(tables["wb_valid"])
+
+    def _accumulate(C):
+        """Leaf-row totals from per-voxel face contributions: fine voxels
+        keep theirs; coarse blocks pool (even-aligned -1-roll chain), park
+        the total at the block origin, then broadcast it back over the
+        block (the ops/flat_amr.py coarse-update scheme)."""
+        if not has_coarse:
+            return C
+        s = C * coarse_f
+        s = s + jnp.roll(s, -1, 2)
+        s = s + jnp.roll(s, -1, 1)
+        s = s + jnp.roll(s, -1, 0)
+        s = s * orig_f
+        s = s + jnp.roll(s, 1, 2)
+        s = s + jnp.roll(s, 1, 1)
+        s = s + jnp.roll(s, 1, 0)
+        return fine_f * C + s
+
+    def apply_fwd(v):
+        C = jnp.zeros(shape, dtype)
+        for (wp, wn), ax in zip(W, (2, 1, 0)):
+            C = C + wp * jnp.roll(v, -1, ax) + wn * jnp.roll(v, 1, ax)
+        return scaling * v + _accumulate(C)
+
+    def apply_rev(v):
+        C = jnp.zeros(shape, dtype)
+        for (wp, wn), ax in zip(W, (2, 1, 0)):
+            C = C + jnp.roll(wp * v, 1, ax) + jnp.roll(wn * v, -1, ax)
+        return scaling * v + _accumulate(C)
+
+    def voxelize(row_arr):
+        return row_arr[0][rows].reshape(shape).astype(dtype)
+
+    def writeback(vox_arr):
+        flat = vox_arr.reshape(-1)
+        return jnp.where(wb_valid, flat[wb_rows], 0)[None]
+
+    masks = dict(
+        solve=jnp.asarray(tables["solve"]),
+        dot=jnp.asarray(tables["dot_mask"]),
+    )
+    return apply_fwd, apply_rev, voxelize, writeback, masks

@@ -112,9 +112,9 @@ def test_refined_operator_matches_oracle():
         v = rng.standard_normal(len(cells))
         st = g.new_state(p.spec)
         st = g.set_cell_data(st, "solution", cells, v)
-        Ax, _ = p._apply(st["solution"], p._mult_fwd)
+        Ax, _ = p._apply(st["solution"], p._mult_tables()[0])
         np.testing.assert_allclose(np.asarray(Ax)[dev, row], A @ v, atol=1e-12)
-        ATx, _ = p._apply(st["solution"], p._mult_rev)
+        ATx, _ = p._apply(st["solution"], p._mult_tables()[1])
         np.testing.assert_allclose(np.asarray(ATx)[dev, row], A.T @ v, atol=1e-12)
 
 
@@ -281,3 +281,111 @@ def test_boundary_and_skip_match_dense_oracle():
     b_eff = rhs[si] - A[np.ix_(si, bi)] @ ub
     want = np.linalg.solve(A[np.ix_(si, si)], b_eff)
     np.testing.assert_allclose(sol[si], want, atol=1e-8)
+
+
+def test_flat_path_matches_gather_refined():
+    """The dense flat-voxel operator (ops/flat_poisson.py) reproduces the
+    gather-table solve on a refined single-device grid."""
+    g = make_grid((8, 8, 8), max_ref=1, n_dev=1)
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    r = np.linalg.norm(c - 0.45, axis=1)
+    for cid in ids[r < 0.3]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+
+    p_flat = Poisson(g)
+    assert p_flat._flat is not None, "flat path must engage"
+    p_gather = Poisson(g, allow_flat=False)
+    assert p_gather._flat is None
+
+    s0 = p_flat.initialize_state(rhs)
+    out_f, res_f, it_f = p_flat.solve(s0, max_iterations=200,
+                                      stop_residual=1e-10)
+    out_g, res_g, it_g = p_gather.solve(s0, max_iterations=200,
+                                        stop_residual=1e-10)
+    assert abs(it_f - it_g) <= 1
+    sf = np.asarray(g.get_cell_data(out_f, "solution", ids))
+    sg = np.asarray(g.get_cell_data(out_g, "solution", ids))
+    np.testing.assert_allclose(sf, sg, rtol=1e-10, atol=1e-12)
+
+
+def test_flat_path_matches_gather_uniform_with_roles():
+    """Flat path on a uniform grid with skip and boundary cells: the cell
+    role rules (poisson_solve.hpp:896-965) survive the flat folding."""
+    g = make_grid((6, 6, 6), periodic=(False, False, False), n_dev=1)
+    cells = g.get_cells()
+    ctr = g.geometry.get_center(cells)
+    # skip a small ball, boundary = domain faces, solve the rest
+    skip = cells[np.linalg.norm(ctr - 0.5, axis=1) < 0.17]
+    on_face = (
+        (ctr < 1.0 / 6).any(axis=1) | (ctr > 5.0 / 6).any(axis=1)
+    )
+    bnd = cells[on_face & ~np.isin(cells, skip)]
+    solve = cells[~on_face & ~np.isin(cells, skip)]
+    rng = np.random.default_rng(3)
+    rhs = rng.standard_normal(len(cells))
+
+    kw = dict(solve_cells=solve, skip_cells=skip)
+    p_flat = Poisson(g, **kw)
+    assert p_flat._flat is not None
+    p_gather = Poisson(g, allow_flat=False, **kw)
+
+    s0 = p_flat.grid.new_state(p_flat.spec)
+    s0 = g.set_cell_data(s0, "rhs", cells, rhs)
+    ub = rng.standard_normal(len(bnd))
+    s0 = g.set_cell_data(s0, "solution", bnd, ub)
+
+    out_f, _, it_f = p_flat.solve(s0, max_iterations=150,
+                                  stop_residual=1e-12)
+    out_g, _, it_g = p_gather.solve(s0, max_iterations=150,
+                                    stop_residual=1e-12)
+    assert abs(it_f - it_g) <= 1
+    sf = np.asarray(g.get_cell_data(out_f, "solution", cells))
+    sg = np.asarray(g.get_cell_data(out_g, "solution", cells))
+    np.testing.assert_allclose(sf, sg, rtol=1e-9, atol=1e-11)
+
+
+def test_flat_path_periodic_self_coupling():
+    """A cell whose periodic neighbor is itself (domain one leaf wide
+    along an axis) must keep the self-coupling the reference's factors
+    produce — the flat path folds it through the wrap faces."""
+    g = make_grid((8, 1, 1), cell_len=(1.0 / 8, 1.0, 1.0), n_dev=1)
+    cells = g.get_cells()
+    c = g.geometry.get_center(cells)
+    rhs = np.sin(2 * np.pi * c[:, 0])
+
+    p_flat = Poisson(g)
+    assert p_flat._flat is not None
+    p_gather = Poisson(g, allow_flat=False)
+
+    s0 = p_flat.initialize_state(rhs)
+    out_f, _, _ = p_flat.solve(s0, max_iterations=100, stop_residual=1e-13)
+    out_g, _, _ = p_gather.solve(s0, max_iterations=100, stop_residual=1e-13)
+    sf = np.asarray(g.get_cell_data(out_f, "solution", cells))
+    sg = np.asarray(g.get_cell_data(out_g, "solution", cells))
+    np.testing.assert_allclose(sf, sg, rtol=1e-9, atol=1e-12)
+
+    # and with a coarse leaf spanning a full periodic voxel axis
+    g2 = make_grid((8, 2, 1), max_ref=1,
+                   cell_len=(1.0 / 8, 0.5, 1.0), n_dev=1)
+    ids = g2.get_cells()
+    for cid in ids[: 4]:
+        g2.refine_completely(int(cid))
+    g2.stop_refining()
+    ids = g2.get_cells()
+    c2 = g2.geometry.get_center(ids)
+    rhs2 = np.sin(2 * np.pi * c2[:, 0]) + 0.3 * np.cos(2 * np.pi * c2[:, 1])
+
+    q_flat = Poisson(g2)
+    assert q_flat._flat is not None
+    q_gather = Poisson(g2, allow_flat=False)
+    s2 = q_flat.initialize_state(rhs2)
+    o_f, _, _ = q_flat.solve(s2, max_iterations=200, stop_residual=1e-13)
+    o_g, _, _ = q_gather.solve(s2, max_iterations=200, stop_residual=1e-13)
+    vf = np.asarray(g2.get_cell_data(o_f, "solution", ids))
+    vg = np.asarray(g2.get_cell_data(o_g, "solution", ids))
+    np.testing.assert_allclose(vf, vg, rtol=1e-9, atol=1e-12)

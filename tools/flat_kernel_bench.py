@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Microbenchmark the whole-run flat-AMR Pallas kernel in isolation.
+
+Times ``ops/flat_amr.make_flat_amr_run`` on synthetic weight tables at a
+sweep of voxel-grid shapes, to separate intrinsic kernel throughput from
+grid effects — in particular the lane-alignment question: the TPU vector
+lane width is 128, so an x extent of 96 forces Mosaic to pad and to lower
+the x rolls as unaligned cross-lane shuffles, while 128 is native.
+
+Run on the real chip (no env overrides):  python tools/flat_kernel_bench.py
+"""
+import pathlib
+import sys
+import time
+import statistics
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dccrg_tpu.ops.flat_amr import make_flat_amr_run
+
+SHAPES = [
+    (96, 96, 96),     # the r02 refined-bench voxel grid (48^3 coarse)
+    (96, 96, 128),    # x lane-aligned, same order of voxels
+    (64, 96, 128),    # x aligned, shallower z
+    (64, 128, 128),   # the dense headline kernel's block shape
+    (128, 128, 128),  # aligned, 2.1M voxels
+]
+STEPS = 1000
+REPS = 5
+
+
+def bench(nz1, ny1, nx1):
+    n_vox = nz1 * ny1 * nx1
+    rng = np.random.default_rng(0)
+    kern = make_flat_amr_run(nz1, ny1, nx1)
+    shape = (nz1, ny1, nx1)
+    V = jnp.asarray(rng.random(shape), jnp.float32)
+    # synthetic but structurally faithful weights: small CFL-scale values,
+    # coarse blocks on one octant
+    w = [jnp.asarray(rng.random(shape) * 1e-3, jnp.float32) for _ in range(6)]
+    fine = np.zeros(shape, np.bool_)
+    fine[: nz1 // 2, : ny1 // 2, : nx1 // 2] = True
+    updf = jnp.asarray(fine / 1.0, jnp.float32)
+    updc = jnp.asarray((~fine) / 8.0, jnp.float32)
+    dt = jnp.float32(1.0)
+
+    out = kern(V, *w, updf, updc, dt, 2)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = kern(V, *w, updf, updc, dt, STEPS)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    rate = n_vox * STEPS / med
+    print(
+        f"shape=({nz1},{ny1},{nx1}) n_vox={n_vox} "
+        f"med={med:.4f}s rate={rate/1e9:.2f} B voxel-updates/s "
+        f"times={[round(t, 4) for t in times]}"
+    )
+    return rate
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, jax.devices()[0].device_kind)
+    for shape in SHAPES:
+        try:
+            bench(*shape)
+        except Exception as e:  # noqa: BLE001 - keep sweeping
+            print(f"shape={shape} FAILED: {str(e)[-200:]}")
+
+
+if __name__ == "__main__":
+    main()
