@@ -256,6 +256,39 @@ def measure_gol() -> dict:
     }
 
 
+def measure_pic() -> dict:
+    """BASELINE.md config 4: particle push + cell migration — the full
+    push/exchange/re-bucket cycle (tests/particles/simple.cpp:285-294) as
+    one device-side loop (sort-based re-bucketing, no host round trips)."""
+    import jax
+    import numpy as np
+
+    from benchmarks.microbench import pic_setup
+
+    length = 32
+    n_particles = 1_000_000
+    pc, pts, vel = pic_setup(n_particles, length)
+    assert pc._dev_rebucket is not None, "device re-bucket must engage"
+    state = pc.new_state(pts)
+    steps = 50
+    dt = 0.2 / length
+    jax.block_until_ready(pc.run(state, 2, velocity=vel, dt=dt)["particles"])
+
+    def one():
+        return pc.run(state, steps, velocity=vel, dt=dt)
+
+    secs, times, out = _median_of(one, n=3)
+    # a physically valid run: every particle accounted for, none dropped
+    assert pc.count(out) == n_particles, "particle conservation violated"
+    assert int(np.asarray(out["overflow"])) == 0, "particles dropped"
+    return {
+        "n_particles": n_particles,
+        "steps": steps,
+        "pushes_per_s_incl_migration": n_particles * steps / secs,
+        "times_s": [round(t, 4) for t in times],
+    }
+
+
 def measure_poisson() -> dict:
     """BASELINE.md config 3: iterative Poisson solve on a refined grid —
     reports solver cell-iterations/s (matrix-free BiCG sweeps are the
@@ -564,7 +597,7 @@ def _main_real():
     tpu = measure_tpu()
     extras = {}
     for name, fn in (("refined", measure_refined), ("large", measure_large),
-                     ("gol", measure_gol),
+                     ("gol", measure_gol), ("pic", measure_pic),
                      ("poisson", measure_poisson), ("vlasov", measure_vlasov),
                      ("multidev_cpu", measure_multidev_cpu)):
         try:
@@ -628,7 +661,7 @@ def _main_real():
             "hbm_peak_GBps": lg.get("hbm_peak_GBps"),
             "hbm_fraction_of_peak": lg.get("hbm_fraction_of_peak"),
         }
-    for name in ("poisson", "vlasov"):
+    for name in ("poisson", "vlasov", "pic"):
         if extras.get(name):
             detail[name] = {
                 k: (round(v, 1) if isinstance(v, float) else v)

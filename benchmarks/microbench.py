@@ -208,10 +208,13 @@ def bench_epoch_rebuild(length: int = 64):
     }))
 
 
-def bench_particles(n_particles: int, length: int = 32):
-    """PIC pushes/s INCLUDING migration (ghost exchange + re-bucketing) —
-    the full per-step cost of the reference's particle test
-    (tests/particles/simple.cpp:285-294), not just the position update."""
+def pic_setup(n_particles: int, length: int = 32):
+    """Shared PIC benchmark fixture (also used by the root bench.py):
+    uniform periodic grid, uniformly-random particles, capacity from the
+    actual max occupancy (Poisson tails overflow any fixed multiple of
+    the mean — doubled for drift during the run), and the rotating
+    velocity field of the reference's particle test.  Returns
+    ``(particles_model, initial_points, velocity_field)``."""
     from dccrg_tpu import CartesianGeometry, Grid, make_mesh
     from dccrg_tpu.models.particles import Particles
 
@@ -229,26 +232,36 @@ def bench_particles(n_particles: int, length: int = 32):
     )
     rng = np.random.default_rng(0)
     pts = rng.uniform(0.0, 1.0, size=(n_particles, 3))
-    # capacity from the actual max occupancy (Poisson tails overflow any
-    # fixed multiple of the mean), doubled for drift during the run
     occ = np.bincount(g.leaves.position(g.get_existing_cell(pts)))
     pc = Particles(g, max_particles_per_cell=2 * int(occ.max()))
-
-    t0 = time.perf_counter()
-    state = pc.new_state(pts)
-    t_bucket = time.perf_counter() - t0
-
     vel = pc.velocity_field(
         lambda c: np.stack(
             [0.5 - c[:, 1], c[:, 0] - 0.5, np.full(len(c), 0.05)], axis=-1
         )
     )
-    steps = 5
+    return pc, pts, vel
+
+
+def bench_particles(n_particles: int, length: int = 32):
+    """PIC pushes/s INCLUDING migration (ghost exchange + re-bucketing) —
+    the full per-step cost of the reference's particle test
+    (tests/particles/simple.cpp:285-294), not just the position update."""
+    pc, pts, vel = pic_setup(n_particles, length)
+
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state = pc.step(state, velocity=vel, dt=0.2 / length)
+    state = pc.new_state(pts)
+    t_bucket = time.perf_counter() - t0
+    steps = 5
+    import jax
+
+    state = pc.run(state, 1, velocity=vel, dt=0.2 / length)  # compile
+    jax.block_until_ready(state["particles"])
+    t0 = time.perf_counter()
+    state = pc.run(state, steps, velocity=vel, dt=0.2 / length)
+    jax.block_until_ready(state["particles"])
     secs = time.perf_counter() - t0
     assert pc.count(state) == n_particles
+    assert int(np.asarray(state.get("overflow", 0))) == 0
     print(json.dumps({
         "metric": "pic_pushes_per_sec_incl_migration",
         "value": round(n_particles * steps / secs, 1),

@@ -11,8 +11,13 @@ TPU-native formulation: ragged lists become padded ``[D, R, P, 3]`` arrays
 plus an ``[D, R]`` count — the padding-based ragged-buffer strategy the
 build plan prescribes.  The push is a jitted array op; the ghost update
 moves counts first and coordinates second through the same halo engine
-(both are exact copies); re-bucketing particles into their new cells is
-host-orchestrated per step, like every structural mutation in this design.
+(both are exact copies).  Re-bucketing particles into their new cells is
+fully device-side on uniform periodic grids (a per-device sort over the
+padded slots inside ``shard_map`` — each device claims the particles of
+its local + ghost rows that land in its own cells, the array form of the
+reference's neighbor handoff), with ``run()`` advancing whole histories
+in one dispatch; other grids re-bucket through the host path, like every
+structural mutation in this design.
 """
 from __future__ import annotations
 
@@ -20,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import shard_spec
+from ..parallel.mesh import SHARD_AXIS, shard_spec
 from ..parallel.stencil import StencilTables
 
 __all__ = ["Particles"]
@@ -34,6 +39,7 @@ class Particles:
         self.tables = StencilTables(grid, hood_id)
         self._exchange = grid.halo(hood_id)
         self._push = self._build_push()
+        self._dev_rebucket = self._build_device_rebucket()
 
     def spec(self):
         return {
@@ -108,6 +114,126 @@ class Particles:
 
         return push
 
+    # --------------------------------------------- device-side re-bucketing
+
+    def _build_device_rebucket(self):
+        """Jitted re-bucket for uniform fully-periodic grids under the
+        id-order block striping: per device, one sort of the padded slots
+        keys particles by target local row; ghost rows supply the
+        neighbors' emigrants (so the CFL-style constraint is the halo
+        width, exactly the reference's neighbor-handoff reach,
+        ``tests/particles/simple.cpp:52-97``).  Returns None when the
+        grid does not qualify — the host path stays the general
+        mechanism.  Overflowing a cell's ``P`` slots drops the excess and
+        counts it in the state's ``overflow`` scalar."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as Pspec
+
+        from ..geometry.cartesian import CartesianGeometry
+        from ..geometry.stretched import StretchedCartesianGeometry
+
+        grid = self.grid
+        epoch = grid.epoch
+        mapping = epoch.mapping
+        leaves = grid.leaves
+        N = len(leaves)
+        if N == 0:
+            return None
+        # uniform Cartesian only: the device path buckets by a single
+        # cell size, which a stretched geometry does not have
+        if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
+            grid.geometry, StretchedCartesianGeometry
+        ):
+            return None
+        if mapping.get_refinement_level(leaves.cells).max() != 0:
+            return None
+        if not all(grid.topology.periodic):
+            return None
+        D, R, P = epoch.n_devices, epoch.R, self.P
+        if N % D != 0 or not np.array_equal(
+            leaves.cells, np.arange(1, N + 1, dtype=np.uint64)
+        ):
+            return None
+        per = N // D
+        expected = np.repeat(np.arange(D, dtype=leaves.owner.dtype), per)
+        if not np.array_equal(leaves.owner, expected):
+            return None
+        # local rows 0..per-1 hold global ids dev*per+1.. in order
+        geo = grid.geometry
+        nx, ny, nz = (int(v) for v in mapping.length)
+        start = np.asarray(geo.get_start(), np.float64)
+        clen = np.asarray(geo.get_level_0_cell_length(), np.float64)
+        dom = clen * np.array([nx, ny, nz], np.float64)
+        dims = np.array([nx, ny, nz], np.int32)
+
+        local_rows = np.asarray(self.tables.local_mask)   # [D, R]
+
+        def body(pos, cnt, local):
+            pos, cnt, local = pos[0], cnt[0], local[0]    # [R,P,3], [R]
+            dev = jax.lax.axis_index(SHARD_AXIS)
+            dt_ = pos.dtype
+            valid = (jnp.arange(P)[None, :] < cnt[:, None]).reshape(-1)
+            p = pos.reshape(R * P, 3)
+            wp = jnp.asarray(start, dt_) + jnp.mod(
+                p - jnp.asarray(start, dt_), jnp.asarray(dom, dt_)
+            )
+            ix = jnp.floor(
+                (wp - jnp.asarray(start, dt_)) / jnp.asarray(clen, dt_)
+            ).astype(jnp.int32)
+            ix = jnp.clip(ix, 0, jnp.asarray(dims - 1))
+            gid0 = ix[:, 0] + nx * (ix[:, 1] + ny * ix[:, 2])
+            tloc = gid0 - dev * per
+            inside = valid & (tloc >= 0) & (tloc < per)
+            key = jnp.where(inside, tloc, R)          # R = drop sentinel
+            order = jnp.argsort(key)
+            ks = key[order]
+            ws = wp[order]
+            slot = jnp.arange(R * P) - jnp.searchsorted(ks, ks, side="left")
+            counts = jnp.zeros(R + 1, jnp.int32).at[key].add(1)[:R]
+            new_pos = (
+                jnp.zeros((R, P, 3), dt_)
+                .at[ks, slot]
+                .set(ws, mode="drop")
+            )
+            new_cnt = jnp.minimum(counts, P)
+            # lost = canonical population before (local rows only; ghost
+            # rows are duplicates) minus population after — catches both
+            # capacity overflow and particles that out-ran the ghost halo
+            # (the device path's reach limit, like the reference's
+            # neighbor handoff)
+            before = jax.lax.psum(
+                jnp.sum(cnt * local).astype(jnp.int32), SHARD_AXIS
+            )
+            after = jax.lax.psum(
+                jnp.sum(new_cnt).astype(jnp.int32), SHARD_AXIS
+            )
+            return new_pos[None], new_cnt[None], before - after
+
+        fn = shard_map(
+            body,
+            mesh=grid.mesh,
+            in_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec(SHARD_AXIS)),
+            out_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec()),
+            check_vma=False,
+        )
+        local_arr = jax.device_put(
+            jnp.asarray(local_rows, jnp.int32), shard_spec(grid.mesh, 2)
+        )
+
+        @jax.jit
+        def rebucket_fn(state):
+            new_pos, new_cnt, lost = fn(
+                state["particles"], state["number_of_particles"], local_arr
+            )
+            return {
+                **state,
+                "particles": new_pos,
+                "number_of_particles": new_cnt,
+                "overflow": state.get("overflow", jnp.int32(0)) + lost,
+            }
+
+        return rebucket_fn
+
     def velocity_field(self, fn) -> np.ndarray:
         """Per-cell velocity array ``[D, R, 3]`` from a function of cell
         centers (``fn((M, 3)) -> (M, 3)``) — the reference's per-cell
@@ -126,16 +252,52 @@ class Particles:
         """Push particles, refresh ghost copies (counts then coordinates —
         the reference's 2-phase idiom), then hand particles to the cells
         that now contain them.  ``velocity`` is a global (3,) vector or a
-        per-cell ``[D, R, 3]`` field (see ``velocity_field``)."""
+        per-cell ``[D, R, 3]`` field (see ``velocity_field``).  On
+        qualifying grids every phase is device-side — no host transfer."""
         state = self._push(state, np.asarray(velocity, dtype=np.float64), dt)
         # phase 1: counts; phase 2: coordinates
         state = {**state, **self._exchange({"number_of_particles": state["number_of_particles"]})}
         state = {**state, **self._exchange({"particles": state["particles"]})}
         return self.rebucket(state)
 
+    def run(self, state, steps: int, velocity=(0.1, 0.0, 0.0),
+            dt: float = 1.0):
+        """Advance ``steps`` push/exchange/re-bucket cycles in ONE
+        device-side loop (requires the device re-bucket path; falls back
+        to per-step host orchestration otherwise)."""
+        if self._dev_rebucket is None:
+            for _ in range(int(steps)):
+                state = self.step(state, velocity, dt)
+            return state
+        if not hasattr(self, "_run"):
+            exchange, push, rebucket = self._exchange, self._push, self._dev_rebucket
+
+            @jax.jit
+            def run_fn(state, steps, velocity, dt):
+                def one(_, st):
+                    st = push(st, velocity, dt)
+                    st = {**st, **exchange(
+                        {"number_of_particles": st["number_of_particles"]}
+                    )}
+                    st = {**st, **exchange({"particles": st["particles"]})}
+                    return rebucket(st)
+
+                return jax.lax.fori_loop(0, steps, one, state)
+
+            self._run = run_fn
+        state = {**state, "overflow": state.get("overflow", jnp.int32(0))}
+        return self._run(
+            state, jnp.asarray(steps, jnp.int32),
+            jnp.asarray(np.asarray(velocity, dtype=np.float64)),
+            jnp.asarray(dt),
+        )
+
     def rebucket(self, state):
-        """Host-orchestrated reassignment of particles to the cells that
-        contain them (periodic wrapping included)."""
+        """Reassignment of particles to the cells that contain them
+        (periodic wrapping included) — the device sort path when the grid
+        qualifies, host-orchestrated otherwise."""
+        if self._dev_rebucket is not None:
+            return self._dev_rebucket(state)
         positions = self.positions(state)
         wrapped = self.grid.geometry.get_real_coordinate(positions)
         if np.isnan(wrapped).any():
@@ -175,5 +337,8 @@ class Particles:
         self.tables = StencilTables(self.grid, self.hood_id)
         self._exchange = self.grid.halo(self.hood_id)
         self._push = self._build_push()
+        self._dev_rebucket = self._build_device_rebucket()
+        if hasattr(self, "_run"):
+            del self._run
         fresh = self.grid.new_state(self.spec())
         return self._scatter(fresh, pts)

@@ -219,3 +219,77 @@ def test_fuzz_particles_random_grids(seed):
         state, velocity=m.velocity_field(lambda c: 0.2 * (c - 0.5)), dt=0.1
     )
     assert m.count(state) == npart
+
+
+def test_device_rebucket_matches_host():
+    """The device-side sort re-bucket (uniform fully-periodic grids) is
+    bit-identical to the host path, across device counts, including the
+    one-dispatch run() loop."""
+    def build(nd):
+        return make_grid((8, 8, 4), periodic=(True, True, True), n_dev=nd)
+
+    rng = np.random.default_rng(7)
+    pts = rng.uniform(0, 1, size=(500, 3))
+    vel = (0.09, -0.04, 0.13)
+
+    results = {}
+    for nd in (1, 4):
+        g = build(nd)
+        pc = Particles(g, max_particles_per_cell=32)
+        assert pc._dev_rebucket is not None
+        s = pc.new_state(pts)
+        s = pc.run(s, 25, velocity=vel, dt=0.5)
+        assert pc.count(s) == 500
+        assert int(np.asarray(s["overflow"])) == 0
+        results[nd] = np.sort(pc.positions(s), axis=0)
+
+    g = build(1)
+    pc = Particles(g, max_particles_per_cell=32)
+    pc._dev_rebucket = None          # force the host mechanism
+    s = pc.new_state(pts)
+    for _ in range(25):
+        s = pc.step(s, velocity=vel, dt=0.5)
+    host = np.sort(pc.positions(s), axis=0)
+    for r in results.values():
+        np.testing.assert_array_equal(r, host)
+
+
+def test_device_rebucket_overflow_counter():
+    """Cell-capacity overflow on the device path drops the excess and
+    counts it (the host path raises instead)."""
+    g = make_grid((4, 4, 4), periodic=(True, True, True), n_dev=1)
+    pc = Particles(g, max_particles_per_cell=2)
+    with pytest.raises(ValueError):
+        pc.new_state(np.full((5, 3), 0.6))  # host scatter rejects
+    # the device path instead drops and counts: converge particles from
+    # several cells into one via a contracting velocity field
+    g2 = make_grid((4, 1, 1), periodic=(True, True, True), n_dev=1)
+    pc2 = Particles(g2, max_particles_per_cell=2)
+    assert pc2._dev_rebucket is not None
+    spread = np.column_stack([
+        np.array([0.05, 0.3, 0.55, 0.8, 0.1, 0.35]),
+        np.full(6, 0.5), np.full(6, 0.5),
+    ])
+    s2 = pc2.new_state(spread)
+    vel = pc2.velocity_field(lambda c: np.column_stack([
+        0.5 - c[:, 0], np.zeros(len(c)), np.zeros(len(c))]))
+    s2 = pc2.run(s2, 8, velocity=vel, dt=1.0)
+    dropped = int(np.asarray(s2["overflow"]))
+    kept = pc2.count(s2)
+    assert dropped > 0
+    assert kept + dropped == 6
+
+
+def test_device_rebucket_counts_beyond_halo_loss():
+    """A particle that out-runs the ghost halo in one step (displacement
+    > 1 cell across a device boundary) cannot be handed off — the device
+    path drops it but must account for it in ``overflow``."""
+    g = make_grid((4, 4, 4), periodic=(True, True, True), n_dev=4)
+    pc = Particles(g, max_particles_per_cell=8)
+    assert pc._dev_rebucket is not None
+    pts = np.array([[0.5, 0.5, 0.125]])   # z-cell 0 on device 0
+    s = pc.new_state(pts)
+    # jump 2 z-cells in one step: lands on device 2, never ghosted here
+    s = pc.run(s, 1, velocity=(0.0, 0.0, 0.5), dt=1.0)
+    assert pc.count(s) == 0
+    assert int(np.asarray(s["overflow"])) == 1
