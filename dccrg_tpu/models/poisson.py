@@ -60,8 +60,9 @@ class Poisson:
 
     def _build_flat(self):
         """Dense flat-voxel operator (ops/flat_poisson.py) — engaged when
-        the grid qualifies (single device, Cartesian, levels ⊆ {0, 1});
-        the gather tables remain the general path and the oracle."""
+        the grid qualifies (Cartesian, levels ⊆ {0, 1}; multi-device when
+        ownership is the voxel z-slab partition); the gather tables
+        remain the general path and the oracle."""
         from ..ops.flat_poisson import (
             build_flat_poisson,
             make_flat_poisson_apply,
@@ -79,7 +80,9 @@ class Poisson:
         )
         if t is None:
             return None
-        return make_flat_poisson_apply(t, jnp.dtype(self.dtype))
+        return make_flat_poisson_apply(
+            t, jnp.dtype(self.dtype), mesh=self.grid.mesh
+        )
 
     def _build_cell_types(self, solve_cells, skip_cells):
         """Per-leaf role array (reference cache_system_info,

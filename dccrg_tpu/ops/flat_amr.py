@@ -69,25 +69,35 @@ def flat_amr_fits(n_voxels: int) -> bool:
     return _FLAT_ARRAYS * n_voxels * 4 <= _FLAT_VMEM_BUDGET
 
 
-def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None):
-    """The shared single-device flat voxel layout, or None if the grid
-    does not qualify (single device, Cartesian, leaf levels ⊆ {0, 1}).
+def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
+                      allow_multi_device=False):
+    """The shared flat voxel layout, or None if the grid does not qualify
+    (Cartesian, leaf levels ⊆ {0, 1}; single device unless
+    ``allow_multi_device`` and the ownership equals the voxel z-slab
+    partition with coarse blocks never straddling slabs).
 
     Returns a dict:
       shape        (nzv, nyv, nxv) voxel grid at max-leaf-level resolution
       vox_level    0 (uniform) or 1
-      rows         (n_vox,) int32 epoch row per voxel (coarse replicated)
+      n_devices    D
+      leaf_idx     (n_vox,) int32 global leaf index per voxel (coarse
+                   leaves replicated over their 2x2x2 block)
       leaf_fine    (nzv, nyv, nxv) bool — voxel is a max-level leaf
-      wb_rows      (R,) int32 — for each epoch row, a representative flat
-                   voxel (fine: its voxel; coarse: block origin); scratch
+      rows         D == 1: (n_vox,) int32 epoch row per voxel;
+                   D > 1:  (D, n_vox_loc) int32 per-device epoch rows of
+                   the device's z-slab voxels
+      wb_rows      D == 1: (R,) int32 — representative flat voxel per
+                   epoch row (fine: its voxel; coarse: block origin);
+                   D > 1: (D, R) slab-local flat voxel per row.  Scratch
                    and invalid rows point at voxel 0
-      wb_valid     (R,) bool
+      wb_valid     (R,) / (D, R) bool
     """
     from ..geometry.cartesian import CartesianGeometry
     from ..geometry.stretched import StretchedCartesianGeometry
 
     epoch = grid.epoch
-    if epoch.n_devices != 1:
+    D = epoch.n_devices
+    if D != 1 and not allow_multi_device:
         return None
     if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
         grid.geometry, StretchedCartesianGeometry
@@ -112,10 +122,21 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None):
     vox = idx >> (L - vl)                # voxel-resolution origin
     flat0 = (vox[:, 2] * nyv + vox[:, 1]) * nxv + vox[:, 0]
 
-    rows = np.zeros(n_vox, dtype=np.int32)
+    if D > 1:
+        if nzv % D != 0:
+            return None
+        slab = nzv // D
+        if vl == 1 and slab % 2 != 0:
+            return None  # coarse blocks would straddle slab boundaries
+        owner_expected = (vox[:, 2] // slab).astype(leaves.owner.dtype)
+        if not np.array_equal(leaves.owner, owner_expected):
+            return None
+
+    leaf_idx = np.zeros(n_vox, dtype=np.int32)
     leaf_fine = np.zeros(n_vox, dtype=bool)
     fine = lvl == vl
-    rows[flat0[fine]] = epoch.row_of[fine]
+    lin = np.arange(N, dtype=np.int32)
+    leaf_idx[flat0[fine]] = lin[fine]
     leaf_fine[flat0[fine]] = True
     coarse = np.flatnonzero(~fine)
     if len(coarse):
@@ -123,19 +144,36 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None):
             for dy in range(2):
                 for dx in range(2):
                     off = (dz * nyv + dy) * nxv + dx
-                    rows[flat0[coarse] + off] = epoch.row_of[coarse]
+                    leaf_idx[flat0[coarse] + off] = lin[coarse]
 
     R = epoch.R
-    wb_rows = np.zeros(R, dtype=np.int32)
-    wb_valid = np.zeros(R, dtype=bool)
-    wb_rows[epoch.row_of] = flat0
-    wb_valid[epoch.row_of] = True
+    row_of = epoch.row_of
+    if D == 1:
+        rows = row_of[leaf_idx].astype(np.int32)
+        wb_rows = np.zeros(R, dtype=np.int32)
+        wb_valid = np.zeros(R, dtype=bool)
+        wb_rows[row_of] = flat0
+        wb_valid[row_of] = True
+    else:
+        slab = nzv // D
+        n_loc = slab * nyv * nxv
+        rows = (
+            row_of[leaf_idx].astype(np.int32).reshape(D, n_loc)
+        )
+        wb_rows = np.zeros((D, R), dtype=np.int32)
+        wb_valid = np.zeros((D, R), dtype=bool)
+        dev = leaves.owner.astype(np.int64)
+        loc0 = flat0 - dev * n_loc
+        wb_rows[dev, row_of] = loc0
+        wb_valid[dev, row_of] = True
 
     return dict(
         shape=(nzv, nyv, nxv),
         vox_level=vl,
-        rows=rows,
+        n_devices=D,
+        leaf_idx=leaf_idx,
         leaf_fine=leaf_fine.reshape(nzv, nyv, nxv),
+        rows=rows,
         wb_rows=wb_rows,
         wb_valid=wb_valid,
     )
@@ -337,37 +375,23 @@ def build_flat_amr_sharded(grid):
     the flat scheme, with the per-step halo two ppermuted voxel planes
     (the same wire pattern as the uniform dense path).
 
-    Requires: levels {0, 1}, Cartesian, nz0 divisible by the device count
-    (slabs then hold whole coarse blocks: nzl1 = 2 nz0/D is even), and
-    ownership equal to the voxel-slab partition.  Returns the static
-    tables dict or None."""
-    from ..geometry.cartesian import CartesianGeometry
-    from ..geometry.stretched import StretchedCartesianGeometry
-
+    Requires the shared layout's multi-device rules (levels {0, 1} with
+    refinement, Cartesian, slabs holding whole coarse blocks, ownership
+    equal to the voxel-slab partition).  Returns the static tables dict
+    or None."""
     epoch = grid.epoch
     D = epoch.n_devices
     if D == 1:
         return None
-    if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
-        grid.geometry, StretchedCartesianGeometry
-    ):
+    lay = flat_voxel_layout(grid, allow_uniform=False,
+                            allow_multi_device=True)
+    if lay is None or lay["leaf_fine"].all():
         return None
-    mapping = epoch.mapping
-    leaves = epoch.leaves
-    N = len(leaves)
-    if N == 0:
-        return None
-    lvl = mapping.get_refinement_level(leaves.cells).astype(np.int64)
-    if lvl.max() != 1 or lvl.min() != 0:
-        return None
-    nx0, ny0, nz0 = (int(v) for v in mapping.length)
-    if nz0 % D != 0:
-        return None
-    L = mapping.max_refinement_level
-    nx1, ny1, nz1 = 2 * nx0, 2 * ny0, 2 * nz0
+    nz1, ny1, nx1 = lay["shape"]
     nzl1 = nz1 // D
     n_loc = nzl1 * ny1 * nx1
-    n_vox = nx1 * ny1 * nz1
+    n_vox = nz1 * ny1 * nx1
+    N = len(epoch.leaves)
     # cost guards (mirroring the boxed path's max_expand and the
     # single-device flat_amr_fits): the 8x inflation must stay within a
     # modest factor of the real leaf count, and the ~12 per-device
@@ -378,44 +402,9 @@ def build_flat_amr_sharded(grid):
     if 12 * n_loc * 4 > (2 << 30):
         return None
 
-    idx = mapping.get_indices(leaves.cells).astype(np.int64)  # (N,3) x,y,z
-    vox = idx >> (L - 1)
-    owner_expected = (vox[:, 2] // nzl1).astype(leaves.owner.dtype)
-    if not np.array_equal(leaves.owner, owner_expected):
-        return None
-
-    zl = vox[:, 2] % nzl1
-    flat_loc = (zl * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
-
-    rows = np.zeros((D, n_loc), dtype=np.int32)
-    leaf_fine = np.zeros((D, nzl1, ny1, nx1), dtype=bool)
-    dev = leaves.owner.astype(np.int64)
-    fine = lvl == 1
-    rows[dev[fine], flat_loc[fine]] = epoch.row_of[fine]
-    lf_flat = leaf_fine.reshape(D, -1)
-    lf_flat[dev[fine], flat_loc[fine]] = True
-    coarse = np.flatnonzero(~fine)
-    for dz in range(2):
-        for dy in range(2):
-            for dx in range(2):
-                off = (dz * ny1 + dy) * nx1 + dx
-                rows[dev[coarse], flat_loc[coarse] + off] = (
-                    epoch.row_of[coarse]
-                )
-
-    R = epoch.R
-    wb_rows = np.zeros((D, R), dtype=np.int32)
-    wb_valid = np.zeros((D, R), dtype=bool)
-    wb_rows[dev, epoch.row_of] = flat_loc
-    wb_valid[dev, epoch.row_of] = True
-
     # ringed leaf mask: the z-neighbor devices' edge planes (static data
     # needs no collective — build it globally and slice)
-    lf_global = np.zeros((nz1, ny1, nx1), dtype=bool)
-    gz = vox[:, 2]
-    gflat = (gz * ny1 + vox[:, 1]) * nx1 + vox[:, 0]
-    lf_g = lf_global.reshape(-1)
-    lf_g[gflat[fine]] = True
+    lf_global = lay["leaf_fine"]
     leaf_ext = np.stack([
         np.concatenate([
             lf_global[(d * nzl1 - 1) % nz1][None],
@@ -429,11 +418,11 @@ def build_flat_amr_sharded(grid):
     return dict(
         shape=(nzl1, ny1, nx1),
         n_devices=D,
-        rows=rows,
-        leaf_fine=leaf_fine,
+        rows=lay["rows"],
+        leaf_fine=lf_global.reshape(D, nzl1, ny1, nx1),
         leaf_ext=leaf_ext,
-        wb_rows=wb_rows,
-        wb_valid=wb_valid,
+        wb_rows=lay["wb_rows"],
+        wb_valid=lay["wb_valid"],
         area_f=np.array([l1[1] * l1[2], l1[0] * l1[2], l1[0] * l1[1]]),
         vol_f=float(l1.prod()),
         vol_c=float(l1.prod() * 8.0),

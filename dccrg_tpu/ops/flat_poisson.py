@@ -29,8 +29,12 @@ Semantics reproduced exactly (reference ``tests/poisson/poisson_solve.hpp``):
   S·Cᵀ·E`` and ``Cᵀ`` is the same six weights applied with reversed
   rolls.
 
-Qualifies: single device, (possibly degenerate) Cartesian geometry,
-leaf levels ⊆ {0, 1}.  The gather path remains the general fallback.
+Qualifies: (possibly degenerate) Cartesian geometry, leaf levels ⊆
+{0, 1}; any device count whose ownership equals the voxel z-slab
+partition — multi-device meshes shard the voxel arrays by z-slab, the
+matvec's z-rolls lower to collective permutes over the device ring, and
+the pool/broadcast chain runs slab-local.  The gather path remains the
+general fallback.
 """
 from __future__ import annotations
 
@@ -54,28 +58,22 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
     """
     from .flat_amr import flat_voxel_layout
 
-    lay = flat_voxel_layout(grid, allow_uniform=True, max_voxels=_MAX_VOXELS)
+    lay = flat_voxel_layout(
+        grid, allow_uniform=True, max_voxels=_MAX_VOXELS,
+        allow_multi_device=True,
+    )
     if lay is None:
         return None
     shape = lay["shape"]
-    rows = lay["rows"]
-    row_of = grid.epoch.row_of
-    R = grid.epoch.R
+    leaf_idx = lay["leaf_idx"]
 
-    # leaf arrays -> row-indexed -> voxel-indexed
-    def to_vox(leaf_arr, fill=0):
-        rshape = (R,) + np.shape(leaf_arr)[1:]
-        row_arr = np.full(rshape, fill, dtype=np.asarray(leaf_arr).dtype)
-        row_arr[row_of] = leaf_arr
-        return row_arr[rows]
-
-    t_vox = to_vox(np.asarray(types_leaf), fill=skip_code)
-    f_pos_vox = to_vox(np.asarray(f_pos))          # (n_vox, 3)
-    f_neg_vox = to_vox(np.asarray(f_neg))
-    scaling_vox = to_vox(np.asarray(scaling_leaf))
+    t_vox = np.asarray(types_leaf)[leaf_idx]
+    f_pos_vox = np.asarray(f_pos)[leaf_idx]        # (n_vox, 3)
+    f_neg_vox = np.asarray(f_neg)[leaf_idx]
+    scaling_vox = np.asarray(scaling_leaf)[leaf_idx]
 
     nz1, ny1, nx1 = shape
-    rows3 = rows.reshape(shape)
+    rows3 = leaf_idx.reshape(shape)   # same-leaf face detection
     fine3 = lay["leaf_fine"]
     t3 = t_vox.reshape(shape)
     sub = np.where(fine3, 1.0, 0.25)   # coarse faces span 4 voxel sub-faces
@@ -123,7 +121,8 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
 
     return dict(
         shape=shape,
-        rows=rows,
+        n_devices=lay["n_devices"],
+        rows=lay["rows"],
         fine=fine3,
         has_coarse=bool((~fine3).any()),
         weights=weights,
@@ -138,44 +137,81 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
     )
 
 
-def make_flat_poisson_apply(tables, dtype):
+def make_flat_poisson_apply(tables, dtype, mesh=None):
     """Returns ``(apply_fwd, apply_rev, voxelize, writeback, masks)``.
 
     ``apply_*`` map a voxel array to A·v / Aᵀ·v in voxel layout (coarse
     rows' results replicated over their blocks).  ``voxelize`` lifts a
-    ``[1, R]`` row array onto the voxel grid; ``writeback`` projects a
-    voxel array onto ``[1, R]`` rows.
-    """
-    shape = tables["shape"]
-    rows = jnp.asarray(tables["rows"])
-    fine_f = jnp.asarray(tables["fine"], dtype)
-    coarse_f = jnp.asarray(~tables["fine"], dtype)
-    orig_f = jnp.asarray(tables["orig"], dtype)
-    scaling = jnp.asarray(tables["scaling"], dtype)
-    W = [
-        (jnp.asarray(wp, dtype), jnp.asarray(wn, dtype))
-        for wp, wn in tables["weights"]
-    ]
-    has_coarse = tables["has_coarse"]
-    wb_rows = jnp.asarray(tables["wb_rows"])
-    wb_valid = jnp.asarray(tables["wb_valid"])
+    ``[D, R]`` row array onto the voxel grid; ``writeback`` projects a
+    voxel array onto ``[D, R]`` rows.
 
-    def _accumulate(C):
+    Multi-device: the voxel arrays are z-slab sharded over the mesh
+    (leading axis); the matvec's z-rolls cross slab boundaries, which
+    XLA lowers to collective permutes over the device ring — the same
+    wire pattern as the dense halo — while the pool/broadcast chain
+    stays slab-local (coarse blocks never straddle slabs by
+    construction).  Lift/project run per device inside ``shard_map``.
+    """
+    D = tables["n_devices"]
+    shape = tables["shape"]
+    if D > 1:
+        from ..parallel.mesh import SHARD_AXIS
+        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+        vox_sharding = NamedSharding(mesh, Pspec(SHARD_AXIS, None, None))
+        put = lambda a, dt=None: jax.device_put(
+            jnp.asarray(a, dt), vox_sharding
+        )
+    else:
+        put = lambda a, dt=None: jnp.asarray(a, dt)
+    fine_f = put(tables["fine"], dtype)
+    coarse_f = put(~tables["fine"], dtype)
+    orig_f = put(tables["orig"], dtype)
+    scaling = put(tables["scaling"], dtype)
+    W = [(put(wp, dtype), put(wn, dtype)) for wp, wn in tables["weights"]]
+    has_coarse = tables["has_coarse"]
+
+    def _accum_math(C, coarse, orig, fine):
         """Leaf-row totals from per-voxel face contributions: fine voxels
         keep theirs; coarse blocks pool (even-aligned -1-roll chain), park
         the total at the block origin, then broadcast it back over the
-        block (the ops/flat_amr.py coarse-update scheme)."""
-        if not has_coarse:
-            return C
-        s = C * coarse_f
+        block (the ops/flat_amr.py coarse-update scheme).  The z-roll
+        wrap planes only ever land on positions the orig/odd-z masking
+        zeroes (blocks are 2-aligned and never straddle the wrap), so the
+        chain is exact with slab-local rolls."""
+        s = C * coarse
         s = s + jnp.roll(s, -1, 2)
         s = s + jnp.roll(s, -1, 1)
         s = s + jnp.roll(s, -1, 0)
-        s = s * orig_f
+        s = s * orig
         s = s + jnp.roll(s, 1, 2)
         s = s + jnp.roll(s, 1, 1)
         s = s + jnp.roll(s, 1, 0)
-        return fine_f * C + s
+        return fine * C + s
+
+    if D > 1 and has_coarse:
+        # run the whole chain per slab inside shard_map: the z-rolls stay
+        # slab-local (coarse blocks never straddle slabs), so no
+        # collective permutes enter the solver's hot loop for pooling
+        from jax import shard_map
+        from ..parallel.mesh import SHARD_AXIS as _AX
+        from jax.sharding import PartitionSpec as _P
+
+        _vox_spec = _P(_AX, None, None)
+        _accum_sharded = shard_map(
+            _accum_math, mesh=mesh,
+            in_specs=(_vox_spec,) * 4,
+            out_specs=_vox_spec,
+            check_vma=False,
+        )
+
+        def _accumulate(C):
+            return _accum_sharded(C, coarse_f, orig_f, fine_f)
+    else:
+        def _accumulate(C):
+            if not has_coarse:
+                return C
+            return _accum_math(C, coarse_f, orig_f, fine_f)
 
     def apply_fwd(v):
         C = jnp.zeros(shape, dtype)
@@ -189,15 +225,61 @@ def make_flat_poisson_apply(tables, dtype):
             C = C + jnp.roll(wp * v, 1, ax) + jnp.roll(wn * v, -1, ax)
         return scaling * v + _accumulate(C)
 
-    def voxelize(row_arr):
-        return row_arr[0][rows].reshape(shape).astype(dtype)
+    if D == 1:
+        rows = jnp.asarray(tables["rows"])
+        wb_rows = jnp.asarray(tables["wb_rows"])
+        wb_valid = jnp.asarray(tables["wb_valid"])
 
-    def writeback(vox_arr):
-        flat = vox_arr.reshape(-1)
-        return jnp.where(wb_valid, flat[wb_rows], 0)[None]
+        def voxelize(row_arr):
+            return row_arr[0][rows].reshape(shape).astype(dtype)
+
+        def writeback(vox_arr):
+            flat = vox_arr.reshape(-1)
+            return jnp.where(wb_valid, flat[wb_rows], 0)[None]
+    else:
+        from jax import shard_map
+
+        nzv, nyv, nxv = shape
+        slab = nzv // D
+        rows_d = jnp.asarray(tables["rows"])        # [D, n_loc]
+        wb_rows = jnp.asarray(tables["wb_rows"])    # [D, R]
+        wb_valid = jnp.asarray(tables["wb_valid"])
+
+        def _lift(row_arr, rmap):
+            return row_arr[0][rmap[0]].reshape(slab, nyv, nxv).astype(dtype)
+
+        def _proj(vox, wb, valid):
+            flat = vox.reshape(-1)
+            return jnp.where(valid[0], flat[wb[0]], 0)[None].astype(dtype)
+
+        from ..parallel.mesh import SHARD_AXIS
+        from jax.sharding import PartitionSpec as Pspec
+
+        lift_fn = shard_map(
+            _lift, mesh=mesh,
+            in_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS)),
+            out_specs=Pspec(SHARD_AXIS, None, None),
+            check_vma=False,
+        )
+        proj_fn = shard_map(
+            _proj, mesh=mesh,
+            in_specs=(
+                Pspec(SHARD_AXIS, None, None),
+                Pspec(SHARD_AXIS),
+                Pspec(SHARD_AXIS),
+            ),
+            out_specs=Pspec(SHARD_AXIS),
+            check_vma=False,
+        )
+
+        def voxelize(row_arr):
+            return lift_fn(row_arr, rows_d)
+
+        def writeback(vox_arr):
+            return proj_fn(vox_arr, wb_rows, wb_valid)
 
     masks = dict(
-        solve=jnp.asarray(tables["solve"]),
-        dot=jnp.asarray(tables["dot_mask"]),
+        solve=put(tables["solve"]),
+        dot=put(tables["dot_mask"]),
     )
     return apply_fwd, apply_rev, voxelize, writeback, masks

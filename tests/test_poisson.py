@@ -389,3 +389,29 @@ def test_flat_path_periodic_self_coupling():
     vf = np.asarray(g2.get_cell_data(o_f, "solution", ids))
     vg = np.asarray(g2.get_cell_data(o_g, "solution", ids))
     np.testing.assert_allclose(vf, vg, rtol=1e-9, atol=1e-12)
+
+
+def test_flat_path_multi_device_invariant():
+    """The z-slab-sharded flat operator engages on multi-device meshes
+    (ownership = voxel slab partition) and matches the single-device
+    solve."""
+    def solve(nd):
+        g = make_grid((8, 8, 8), max_ref=1, n_dev=nd)
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        for cid in ids[np.linalg.norm(c - 0.45, axis=1) < 0.3]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+        p = Poisson(g)
+        assert p._flat is not None, f"flat path must engage at D={nd}"
+        s = p.initialize_state(rhs)
+        out, _, it = p.solve(s, max_iterations=100, stop_residual=1e-11)
+        return np.asarray(g.get_cell_data(out, "solution", ids)), it
+
+    s1, i1 = solve(1)
+    s4, i4 = solve(4)
+    assert abs(i1 - i4) <= 1
+    np.testing.assert_allclose(s1, s4, rtol=1e-11, atol=1e-14)
