@@ -332,18 +332,62 @@ def measure_poisson() -> dict:
                                   stop_residual=0.0)[0]["solution"])
 
     def one():
-        out, _res, _it = p.solve(state, max_iterations=iters,
-                                 stop_residual=0.0)
-        return out["solution"]
+        # keep the actual iteration count: the BiCG loop can exit early
+        # (dot_r breakdown / residual-increase stop), and the rate must
+        # count the iterations that really ran
+        out, _res, it = p.solve(state, max_iterations=iters,
+                                stop_residual=0.0,
+                                stop_after_residual_increase=float("inf"))
+        return out["solution"], it
 
-    secs, times, _ = _median_of(one, n=3)
+    secs, times, (_, it_ran) = _median_of(one, n=3)
+    it_ran = max(int(it_ran), 1)
     n_cells = len(ids)
-    return {
+    out = {
         "n_cells": n_cells,
-        "iterations": iters,
-        "cell_iterations_per_s": n_cells * iters / secs,
+        "iterations": it_ran,
+        "cell_iterations_per_s": n_cells * it_ran / secs,
         "times_s": [round(t, 4) for t in times],
+        "path": "flat" if p._flat is not None else "gather",
     }
+    # uniform 64^3 variant with a like-for-like C++ BiCG denominator
+    # (tools/cpu_poisson_baseline.cpp: same iteration structure, AoS +
+    # neighbor indirection, all cores)
+    nu = 64
+    gu = _uniform_grid((nu, nu, nu))
+    cu = gu.geometry.get_center(gu.get_cells())
+    rhs_u = np.sin(2 * np.pi * cu[:, 0]) * np.cos(2 * np.pi * cu[:, 1])
+    pu = Poisson(gu, dtype=np.float32)
+    su = pu.initialize_state(rhs_u)
+    jax.block_until_ready(pu.solve(su, max_iterations=2,
+                                   stop_residual=0.0)[0]["solution"])
+
+    def one_u():
+        out_u, _res, it = pu.solve(su, max_iterations=iters,
+                                   stop_residual=0.0,
+                                   stop_after_residual_increase=float("inf"))
+        return out_u["solution"], it
+
+    secs_u, times_u, (_, it_u) = _median_of(one_u, n=3)
+    it_u = max(int(it_u), 1)
+    try:
+        cpu = _cpu_denominator(
+            f"poisson_{nu}^3", "cpu_poisson_baseline", [nu, nu, nu, 30]
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"poisson cpu baseline failed: {e}", file=sys.stderr)
+        cpu = None
+    rate_u = nu ** 3 * it_u / secs_u
+    out["uniform"] = {
+        "n_cells": nu ** 3,
+        "iterations": it_u,
+        "cell_iterations_per_s": rate_u,
+        "path": "flat" if pu._flat is not None else "gather",
+        "cpu_baseline_cell_iterations_per_s": cpu,
+        "vs_baseline": round(rate_u / cpu, 3) if cpu else -1,
+        "times_s": [round(t, 4) for t in times_u],
+    }
+    return out
 
 
 def measure_vlasov() -> dict:
@@ -572,21 +616,33 @@ def main():
                      "no accelerator number could be produced at bench "
                      "time",
             "diagnostics": diag,
-            # Real-chip numbers measured manually on this round's code
-            # earlier in the round (TPU v5 lite through the same tunnel,
-            # before a multi-hour tunnel outage), recorded so an outage
-            # at bench time does not erase the round's measured state:
+            # Real-chip numbers from the last full on-chip bench run of
+            # this round's code (TPU v5 lite through the tunnel,
+            # 2026-07-30 ~15:00 UTC, before a multi-hour tunnel outage),
+            # recorded so an outage at bench time does not erase the
+            # round's measured state:
             "last_measured_this_round": {
-                "headline_median_updates_per_s_per_chip": 5.28e10,
-                "headline_best_updates_per_s_per_chip": 9.04e10,
-                "headline_times_s_8rep": [0.0989, 0.0985, 0.0971, 0.1,
-                                          0.1027, 0.1024, 0.0945, 0.0997],
-                "large_streaming_updates_per_s": 1.58e10,
-                "large_streaming_note": "blocked z-slab kernel, median "
-                                        "of 5 (13.3e9 before it landed)",
-                "vs_baseline_headline": 807.0,
-                "note": "flat-AMR and fused-GoL kernels landed after the "
-                        "outage began and have no on-chip numbers yet",
+                "headline_median_updates_per_s_per_chip": 4.879e10,
+                "headline_best_updates_per_s_per_chip": 5.138e10,
+                "headline_times_s_8rep": [0.1168, 0.1031, 0.1095, 0.1043,
+                                          0.1071, 0.102, 0.1206, 0.1078],
+                "vs_baseline_headline": 745.6,
+                "refined_updates_per_s": 1.814e9,
+                "refined_vs_baseline": 27.7,
+                "refined_note": "boxed per-level path (the cost heuristic "
+                                "now picks it over the flat kernel at "
+                                "this inflation; flat measured 1.34e9 "
+                                "after its VMEM fix)",
+                "large_streaming_updates_per_s": 1.600e10,
+                "large_vs_baseline": 244.5,
+                "large_hbm_fraction_of_peak": 0.391,
+                "poisson_cell_iterations_per_s": 7.05e6,
+                "poisson_note": "gather path; the flat dense BiCG path "
+                                "landed after the outage began and has "
+                                "no on-chip number yet",
+                "vlasov_phase_updates_per_s": 6.10e9,
+                "note": "fused-GoL and device-side PIC measurements also "
+                        "await the tunnel",
             },
             "multidev_cpu": r8,
         },

@@ -1,0 +1,110 @@
+// CPU baseline for the Poisson benchmark: the reference's matrix-free
+// BiCG iteration (tests/poisson/poisson_solve.hpp — Numerical Recipes
+// 2.7.6 variant: per iteration two matrix applications A.p0 / A^T.p1,
+// three global dots, four axpys) on a uniform periodic grid, with the
+// reference's compute pattern: AoS cells carrying the solver vectors and
+// per-face factors, neighbor access through an index indirection list,
+// double precision, multi-threaded over all host cores.
+//
+// The actual reference (dccrg + MPI + Zoltan) cannot be built in this
+// image; this program re-creates its hot loop as the honest MPI-CPU
+// denominator for BASELINE.md's protocol, exactly like
+// tools/cpu_baseline.cpp does for advection.
+//
+// Usage: cpu_poisson_baseline NX NY NZ ITERS  -> prints cell-iterations/s
+#include <cstdio>
+#include <cstdlib>
+#include <cstdint>
+#include <chrono>
+#include <cmath>
+#include <vector>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+struct Cell {
+    double rhs, x, r0, r1, p0, p1, Ap, ATp;
+    double scale;     // diagonal
+    double f[6];      // -x +x -y +y -z +z face factors
+};
+
+int main(int argc, char** argv) {
+    const int64_t nx = argc > 1 ? atoll(argv[1]) : 64;
+    const int64_t ny = argc > 2 ? atoll(argv[2]) : 64;
+    const int64_t nz = argc > 3 ? atoll(argv[3]) : 64;
+    const int64_t iters = argc > 4 ? atoll(argv[4]) : 30;
+    const int64_t n = nx * ny * nz;
+
+    std::vector<Cell> cells(n);
+    std::vector<int64_t> nbr(n * 6);
+    const double dx = 1.0 / nx, dy = 1.0 / ny, dz = 1.0 / nz;
+    const double fx = 2.0 / (2.0 * dx * 4.0 * dx);
+    const double fy = 2.0 / (2.0 * dy * 4.0 * dy);
+    const double fz = 2.0 / (2.0 * dz * 4.0 * dz);
+    for (int64_t z = 0; z < nz; z++)
+    for (int64_t y = 0; y < ny; y++)
+    for (int64_t x = 0; x < nx; x++) {
+        const int64_t i = x + nx * (y + ny * z);
+        Cell& c = cells[i];
+        const double cx = (x + 0.5) * dx, cy = (y + 0.5) * dy;
+        c.rhs = sin(2 * M_PI * cx) * cos(2 * M_PI * cy);
+        c.x = c.r0 = c.r1 = c.p0 = c.p1 = 0.0;
+        c.f[0] = c.f[1] = fx; c.f[2] = c.f[3] = fy; c.f[4] = c.f[5] = fz;
+        c.scale = -2.0 * (fx + fy + fz);
+        nbr[i * 6 + 0] = ((x + nx - 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 1] = ((x + 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 2] = x + nx * (((y + ny - 1) % ny) + ny * z);
+        nbr[i * 6 + 3] = x + nx * (((y + 1) % ny) + ny * z);
+        nbr[i * 6 + 4] = x + nx * (y + ny * ((z + nz - 1) % nz));
+        nbr[i * 6 + 5] = x + nx * (y + ny * ((z + 1) % nz));
+    }
+    // r = rhs - A.x (x = 0), p = r
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; i++) {
+        cells[i].r0 = cells[i].r1 = cells[i].p0 = cells[i].p1 = cells[i].rhs;
+    }
+    double dot_r = 0;
+#pragma omp parallel for schedule(static) reduction(+:dot_r)
+    for (int64_t i = 0; i < n; i++) dot_r += cells[i].r0 * cells[i].r1;
+
+    const auto t0 = std::chrono::high_resolution_clock::now();
+    for (int64_t it = 0; it < iters; it++) {
+        double dot_p = 0;
+#pragma omp parallel for schedule(static) reduction(+:dot_p)
+        for (int64_t i = 0; i < n; i++) {
+            Cell& c = cells[i];
+            double ap = c.scale * c.p0, atp = c.scale * c.p1;
+            for (int k = 0; k < 6; k++) {
+                const Cell& o = cells[nbr[i * 6 + k]];
+                ap += c.f[k] * o.p0;
+                atp += c.f[k] * o.p1;   // A^T: symmetric factors here,
+            }                            // same work shape as reference
+            c.Ap = ap; c.ATp = atp;
+            dot_p += c.p1 * ap;
+        }
+        const double alpha = dot_p != 0 ? dot_r / dot_p : 0.0;
+        double new_dot_r = 0;
+#pragma omp parallel for schedule(static) reduction(+:new_dot_r)
+        for (int64_t i = 0; i < n; i++) {
+            Cell& c = cells[i];
+            c.x += alpha * c.p0;
+            c.r0 -= alpha * c.Ap;
+            c.r1 -= alpha * c.ATp;
+            new_dot_r += c.r0 * c.r1;
+        }
+        const double beta = dot_r != 0 ? new_dot_r / dot_r : 0.0;
+#pragma omp parallel for schedule(static)
+        for (int64_t i = 0; i < n; i++) {
+            Cell& c = cells[i];
+            c.p0 = c.r0 + beta * c.p0;
+            c.p1 = c.r1 + beta * c.p1;
+        }
+        dot_r = new_dot_r;
+    }
+    const auto t1 = std::chrono::high_resolution_clock::now();
+    const double secs = std::chrono::duration<double>(t1 - t0).count();
+    volatile double sink = cells[n / 2].x;
+    (void)sink;
+    printf("%.6e\n", double(n) * iters / secs);
+    return 0;
+}
