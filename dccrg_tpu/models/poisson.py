@@ -239,18 +239,24 @@ class Poisson:
 
     # ----------------------------------------------------------- solver
 
-    def _mult_tables(self):
-        """Device copies of the [D, R, K] fwd/rev multiplier tables,
-        uploaded on first gather-path use."""
+    def _mult_table(self, i):
+        """Device copy of the [D, R, K] multiplier table ``i`` (0 = fwd,
+        1 = rev/transpose), uploaded on first gather-path use — per
+        table, so residual() diagnostics on a flat-path solver only pin
+        the forward one."""
         if self._mult_dev is None:
+            self._mult_dev = [None, None]
+        if self._mult_dev[i] is None:
             from ..parallel.mesh import shard_spec
 
-            put = lambda a: jax.device_put(
-                jnp.asarray(a, self.dtype), shard_spec(self.grid.mesh, 3)
+            self._mult_dev[i] = jax.device_put(
+                jnp.asarray(self._mult_np[i], self.dtype),
+                shard_spec(self.grid.mesh, 3),
             )
-            self._mult_dev = tuple(put(a) for a in self._mult_np)
-            self._mult_np = None  # host copies served their purpose
-        return self._mult_dev
+        return self._mult_dev[i]
+
+    def _mult_tables(self):
+        return self._mult_table(0), self._mult_table(1)
 
     def _apply(self, x, mult):
         """A·x (or Aᵀ·x with the transpose table): ghost-refresh then
@@ -372,6 +378,6 @@ class Poisson:
         return state, float(res), int(it)
 
     def residual(self, state) -> float:
-        Ax, _ = self._apply(state["solution"], self._mult_tables()[0])
+        Ax, _ = self._apply(state["solution"], self._mult_table(0))
         r = np.asarray(jnp.where(self._solve_mask, state["rhs"] - Ax, 0.0))
         return float(np.sqrt((r * r).sum()))
