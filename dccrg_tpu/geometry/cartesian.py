@@ -30,6 +30,10 @@ class CartesianGeometry:
     level_0_cell_length: tuple[float, float, float] = (1.0, 1.0, 1.0)
 
     geometry_id = 1
+    #: every level-0 cell shares one physical size — the capability the
+    #: dense/boxed/flat fast paths and the device particle re-bucket
+    #: require before trusting get_level_0_cell_length as a global metric
+    uniform_level0 = True
 
     def __post_init__(self):
         object.__setattr__(self, "start", tuple(float(v) for v in self.start))

@@ -28,6 +28,7 @@ class StretchedCartesianGeometry:
     coordinates: tuple = ()
 
     geometry_id = 2
+    uniform_level0 = False  # per-dimension arbitrary cell boundaries
 
     def __post_init__(self):
         coords = tuple(np.asarray(c, dtype=np.float64) for c in self.coordinates)

@@ -139,6 +139,13 @@ class Grid:
         self._rebuild()
         return self
 
+    def _uniform_geometry(self) -> bool:
+        """Whether every level-0 cell shares one physical size — the
+        precondition for the dense fast path's metric factors (a
+        stretched geometry's ``get_level_0_cell_length`` describes only
+        its first cell)."""
+        return bool(getattr(self.geometry, "uniform_level0", False))
+
     def _rebuild(self):
         """Recompute every derived structure for the current leaf set —
         the analogue of the reference's post-mutation rebuild tail
@@ -149,6 +156,7 @@ class Grid:
             self.epoch = build_epoch(
                 self.mapping, self.topology, self.leaves, self.n_devices,
                 self.neighborhoods,
+                uniform_geometry=self._uniform_geometry(),
             )
         self._halo_cache = {}
         self._id_pos_cache = None
@@ -642,6 +650,7 @@ class Grid:
         new_epoch = build_epoch(
             self.mapping, self.topology, new_leaves, self.n_devices,
             self.neighborhoods,
+            uniform_geometry=self._uniform_geometry(),
         )
         self._staged_lb = {
             "noop": False,

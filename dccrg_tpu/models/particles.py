@@ -129,8 +129,6 @@ class Particles:
         from jax import shard_map
         from jax.sharding import PartitionSpec as Pspec
 
-        from ..geometry.cartesian import CartesianGeometry
-        from ..geometry.stretched import StretchedCartesianGeometry
 
         grid = self.grid
         epoch = grid.epoch
@@ -141,9 +139,7 @@ class Particles:
             return None
         # uniform Cartesian only: the device path buckets by a single
         # cell size, which a stretched geometry does not have
-        if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
-            grid.geometry, StretchedCartesianGeometry
-        ):
+        if not getattr(grid.geometry, "uniform_level0", False):
             return None
         if mapping.get_refinement_level(leaves.cells).max() != 0:
             return None

@@ -92,16 +92,12 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
                    and invalid rows point at voxel 0
       wb_valid     (R,) / (D, R) bool
     """
-    from ..geometry.cartesian import CartesianGeometry
-    from ..geometry.stretched import StretchedCartesianGeometry
 
     epoch = grid.epoch
     D = epoch.n_devices
     if D != 1 and not allow_multi_device:
         return None
-    if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
-        grid.geometry, StretchedCartesianGeometry
-    ):
+    if not getattr(grid.geometry, "uniform_level0", False):
         return None
     mapping = epoch.mapping
     leaves = epoch.leaves

@@ -106,14 +106,10 @@ class BoxedLayout:
 def build_boxed(grid, hood_id=None, max_expand: float = 8.0):
     """Build the boxed layout for the current epoch, or return ``None`` if
     the grid does not qualify (see module docstring)."""
-    from ..geometry.cartesian import CartesianGeometry
-    from ..geometry.stretched import StretchedCartesianGeometry
 
     epoch = grid.epoch
     D = epoch.n_devices
-    if not isinstance(grid.geometry, CartesianGeometry) or isinstance(
-        grid.geometry, StretchedCartesianGeometry
-    ):
+    if not getattr(grid.geometry, "uniform_level0", False):
         return None
     hood = epoch.hoods.get(hood_id)
     if hood is None:

@@ -150,11 +150,19 @@ def build_epoch(
     leaves: LeafSet,
     n_devices: int,
     neighborhoods: dict,
+    *,
+    uniform_geometry: bool,
 ) -> Epoch:
     """Build the complete derived state for a (leaves, owner) snapshot.
 
     ``neighborhoods``: dict hood-id -> (K,3) offsets; must contain the
     default hood under key ``None``.
+
+    ``uniform_geometry``: whether all level-0 cells share one physical
+    size (plain Cartesian).  The dense fast-path consumers (advection,
+    Vlasov) read their metric factors from ``get_level_0_cell_length``,
+    which is only meaningful then — a stretched geometry must not
+    qualify.
     """
     N = len(leaves)
     D = n_devices
@@ -229,7 +237,10 @@ def build_epoch(
             epoch, offsets, lists, to_start, to_src, h_pairs, len_all,
             is_outer,
         )
-    epoch.dense = detect_dense(mapping, topology, leaves, D)
+    epoch.dense = (
+        detect_dense(mapping, topology, leaves, D)
+        if uniform_geometry else None
+    )
     return epoch
 
 
