@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""One-shot on-chip measurement battery for round 3's new paths.
+
+Run when the TPU tunnel is up:  python tools/onchip_r3.py
+Writes results incrementally to tools/onchip_r3.json (so a mid-run
+tunnel drop preserves what completed).
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+OUT = ROOT / "tools" / "onchip_r3.json"
+
+
+def record(key, value):
+    data = json.loads(OUT.read_text()) if OUT.exists() else {}
+    data[key] = value
+    OUT.write_text(json.dumps(data, indent=1))
+    print(f"[onchip] {key}: recorded", flush=True)
+
+
+def run_child(code, timeout=1500):
+    """Each measurement in its own process: a tunnel drop kills one
+    measurement, not the battery."""
+    r = subprocess.run([sys.executable, "-c", code], text=True,
+                       capture_output=True, timeout=timeout, cwd=str(ROOT))
+    line = next((ln for ln in reversed(r.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if r.returncode == 0 and line:
+        return json.loads(line)
+    return {"error": (r.stderr or r.stdout)[-800:]}
+
+
+PRELUDE = """
+import sys, json, time, statistics
+sys.path.insert(0, %r)
+import jax
+import numpy as np
+""" % str(ROOT)
+
+
+def main():
+    # 1. flat kernel shape sweep (lane-alignment question)
+    code = PRELUDE + """
+import tools.flat_kernel_bench as fkb
+out = {}
+for shape in fkb.SHAPES:
+    try:
+        out["x".join(map(str, shape))] = round(fkb.bench(*shape) / 1e9, 3)
+    except Exception as e:
+        out["x".join(map(str, shape))] = str(e)[-150:]
+print(json.dumps(out))
+"""
+    record("flat_kernel_sweep_Bvox_per_s", run_child(code, 2400))
+
+    # 2. GoL fused kernel (bench config)
+    code = PRELUDE + """
+import bench
+print(json.dumps(bench.measure_gol()))
+"""
+    record("gol", run_child(code))
+
+    # 3. refined advection through the current dispatch (boxed preferred)
+    code = PRELUDE + """
+import bench
+print(json.dumps(bench.measure_refined()))
+"""
+    record("refined_dispatch", run_child(code))
+
+    # 4. device-side PIC
+    code = PRELUDE + """
+import bench
+print(json.dumps(bench.measure_pic()))
+"""
+    record("pic", run_child(code))
+
+    # 5. flat Poisson (refined + uniform)
+    code = PRELUDE + """
+import bench
+print(json.dumps(bench.measure_poisson()))
+"""
+    record("poisson", run_child(code))
+
+    print("[onchip] battery complete:", OUT, flush=True)
+
+
+if __name__ == "__main__":
+    main()
