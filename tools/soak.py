@@ -542,16 +542,43 @@ def one(seed):
     if pf._flat is None:
         return 'gather-only'
     pg = Poisson(g, allow_flat=False, **kw)
+
+    # operator-level oracle: A.v and A^T.v must agree to fp roundoff on
+    # a random vector (BiCG trajectories may legitimately diverge on
+    # near-singular systems, so the solver output is only compared by
+    # solution QUALITY below)
+    vr = rng.standard_normal(len(cells))
+    sV = g.new_state(pf.spec)
+    sV = g.set_cell_data(sV, 'solution', cells, vr)
+    mf, mr = pg._mult_tables()
+    af, ar, vox, wb, _masks = pf._flat
+    for mult, fl in ((mf, af), (mr, ar)):
+        a_g, _ = pg._apply(sV['solution'], mult)
+        a_f = wb(fl(vox(sV['solution'])))
+        ag = np.asarray(g.get_cell_data({'solution': a_g}, 'solution', cells))
+        afc = np.asarray(g.get_cell_data({'solution': a_f}, 'solution', cells))
+        ops = max(1.0, np.abs(ag).max())
+        assert np.abs(ag - afc).max() < 1e-10 * ops, (
+            seed, np.abs(ag - afc).max(), ops)
+
     s0 = g.new_state(pf.spec)
     s0 = g.set_cell_data(s0, 'rhs', cells, rhs - rhs.mean())
     of, rf, itf = pf.solve(s0, max_iterations=60, stop_residual=1e-11)
     og, rg, itg = pg.solve(s0, max_iterations=60, stop_residual=1e-11)
-    assert abs(itf - itg) <= 1, (seed, itf, itg)
-    sf = np.asarray(g.get_cell_data(of, 'solution', cells))
-    sg = np.asarray(g.get_cell_data(og, 'solution', cells))
-    scale = max(1.0, np.abs(sg).max())
-    assert np.abs(sf - sg).max() < 1e-8 * scale, (
-        seed, np.abs(sf - sg).max(), scale)
+    # solution quality under the GATHER operator (the oracle): the flat
+    # solve must be as good as the gather solve up to a modest factor
+    rf_chk = pg.residual(of)
+    rg_chk = pg.residual(og)
+    rhs_norm = float(np.linalg.norm(rhs))
+    assert rf_chk <= 10.0 * rg_chk + 1e-9 * rhs_norm, (
+        seed, rf_chk, rg_chk)
+    if max(rf_chk, rg_chk) < 1e-10 * rhs_norm:
+        # both fully converged: solutions must coincide
+        sf = np.asarray(g.get_cell_data(of, 'solution', cells))
+        sg = np.asarray(g.get_cell_data(og, 'solution', cells))
+        scale = max(1.0, np.abs(sg).max())
+        assert np.abs(sf - sg).max() < 1e-7 * scale, (
+            seed, np.abs(sf - sg).max(), scale)
     return 'flat-ok', n_dev, mode
 
 for seed in range(int(sys.argv[1]), int(sys.argv[2])):
