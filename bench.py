@@ -457,6 +457,61 @@ def run(n_devices):
                 virtual_cpu_halo_GBps=halo_bytes / best / 1e9,
                 checksum=checksum)
 
+def pic_cpu():
+    # device-side sort re-bucket mechanism on the virtual mesh: one
+    # dispatch for the whole history, conservation + zero loss asserted
+    from dccrg_tpu.models.particles import Particles
+    length = 16
+    g = (Grid().set_initial_length((length,)*3).set_neighborhood_length(1)
+         .set_periodic(True, True, True)
+         .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                       level_0_cell_length=(1.0/length,)*3)
+         .initialize(mesh=make_mesh(n_devices=1)))
+    rng = np.random.default_rng(0)
+    n_p = 100_000
+    pts = rng.uniform(0.0, 1.0, size=(n_p, 3))
+    occ = np.bincount(g.leaves.position(g.get_existing_cell(pts)))
+    pc = Particles(g, max_particles_per_cell=2 * int(occ.max()))
+    assert pc._dev_rebucket is not None
+    s = pc.new_state(pts)
+    vel = pc.velocity_field(lambda c: np.stack(
+        [0.5 - c[:, 1], c[:, 0] - 0.5, np.full(len(c), 0.05)], axis=-1))
+    steps = 20
+    jax.block_until_ready(pc.run(s, 2, velocity=vel, dt=0.2/length)["particles"])
+    t0 = time.perf_counter()
+    out = pc.run(s, steps, velocity=vel, dt=0.2/length)
+    jax.block_until_ready(out["particles"])
+    secs = time.perf_counter() - t0
+    assert pc.count(out) == n_p
+    assert int(np.asarray(out["overflow"])) == 0
+    return dict(n_particles=n_p, steps=steps, secs=round(secs, 4),
+                virtual_cpu_pushes_per_s=round(n_p * steps / secs, 1))
+
+def poisson_flat_cpu():
+    # gather-free flat BiCG on the virtual mesh (z-slab sharded)
+    from dccrg_tpu.models import Poisson
+    nu = 32
+    g = (Grid().set_initial_length((nu,)*3).set_neighborhood_length(0)
+         .set_periodic(True, True, True)
+         .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                       level_0_cell_length=(1.0/nu,)*3)
+         .initialize(mesh=make_mesh(n_devices=8)))
+    c = g.geometry.get_center(g.get_cells())
+    rhs = np.sin(2*np.pi*c[:, 0]) * np.cos(2*np.pi*c[:, 1])
+    p = Poisson(g, dtype=np.float32)
+    assert p._flat is not None
+    s = p.initialize_state(rhs)
+    iters = 30
+    jax.block_until_ready(p.solve(s, max_iterations=2,
+                                  stop_residual=0.0)[0]["solution"])
+    t0 = time.perf_counter()
+    _o, _r, it = p.solve(s, max_iterations=iters, stop_residual=0.0,
+                         stop_after_residual_increase=float("inf"))
+    secs = time.perf_counter() - t0
+    return dict(n_cells=nu**3, iterations=int(it), secs=round(secs, 4),
+                virtual_cpu_cell_iterations_per_s=round(nu**3 * int(it) / secs, 1),
+                path="flat", n_devices=8)
+
 def overlap_gol():
     # split-phase (inner/outer + independent collective) vs blocking GoL.
     # On a multi-core host the collective overlaps the inner compute; on
@@ -494,6 +549,14 @@ r8 = run(8)
 r1 = run(1)
 r8["checksum_rel_err_vs_1dev"] = abs(r8["checksum"] - r1["checksum"]) / abs(r1["checksum"])
 r8["gol_overlap"] = overlap_gol()
+try:
+    r8["pic"] = pic_cpu()
+except Exception as e:
+    r8["pic"] = {"error": str(e)[-200:]}
+try:
+    r8["poisson_flat"] = poisson_flat_cpu()
+except Exception as e:
+    r8["poisson_flat"] = {"error": str(e)[-200:]}
 print("BENCH_JSON:" + json.dumps(r8))
 """ % str(ROOT)
     env = dict(os.environ)
