@@ -29,6 +29,7 @@ from .parallel.epoch import build_epoch
 from .parallel.halo import HaloExchange
 from .parallel.mesh import SHARD_AXIS, make_mesh, shard_spec
 from .parallel.partition import block_partition, hilbert_partition, morton_partition
+from .utils.collectives import fetch
 
 __all__ = ["Grid", "CellSpec", "HAS_NO_NEIGHBOR", "HAS_LOCAL_NEIGHBOR_OF",
            "HAS_LOCAL_NEIGHBOR_TO", "HAS_REMOTE_NEIGHBOR_OF",
@@ -360,7 +361,7 @@ class Grid:
         if (pos < 0).any():
             raise ValueError("set_cell_data: non-existing cell")
         dev, row = self.epoch.global_rows(pos)
-        host = np.array(state[field])
+        host = fetch(state[field]).copy()
         host[dev, row] = values
         new = jax.device_put(
             jnp.asarray(host), shard_spec(self.mesh, host.ndim)
@@ -374,7 +375,7 @@ class Grid:
         if (pos < 0).any():
             raise ValueError("get_cell_data: non-existing cell")
         dev, row = self.epoch.global_rows(pos)
-        return np.asarray(state[field])[dev, row]
+        return fetch(state[field])[dev, row]
 
     # ---------------------------------------------------------------- halo
 
@@ -693,7 +694,10 @@ class Grid:
             d_old, r_old = old.leaves.owner[pos], old.row_of[pos]
             d_new, r_new = new.leaves.owner[pos], new.row_of[pos]
             for k, arr in state.items():
-                st["staged"][k][d_new, r_new] = np.asarray(arr[d_old, r_old])
+                # per-chunk capture from the state passed to THIS call
+                # (the split-phase contract); the eager gather runs SPMD
+                # on every controller, fetch() brings the chunk home
+                st["staged"][k][d_new, r_new] = fetch(arr[d_old, r_old])
             st["done"] = hi
         return hi < N
 
@@ -1007,7 +1011,7 @@ class Grid:
         ) & ~is_child
 
         for name, arr in state.items():
-            host_old = np.asarray(arr, dtype=arr.dtype)
+            host_old = fetch(arr, dtype=arr.dtype)
             field_shape = host_old.shape[2:]
             host_new = np.zeros((new.n_devices, new.R) + field_shape, host_old.dtype)
             pol = policy.get(name, {})

@@ -25,8 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import shard_spec
+from ..parallel.mesh import put_table, shard_spec
 from ..parallel.stencil import StencilTables, gather_neighbors, ordered_sum
+from ..utils.collectives import fetch
 
 __all__ = ["Advection"]
 
@@ -135,9 +136,7 @@ class Advection:
         self.inv_volume = np.where(vol > 0, 1.0 / vol, 0.0)
 
         mesh = self.grid.mesh
-        put = lambda a, dt: jax.device_put(
-            jnp.asarray(a, dtype=dt), shard_spec(mesh, np.ndim(a))
-        )
+        put = lambda a, dt: put_table(a, mesh, dt)
         dtype = self.dtype
         self._dev = {
             "face_dir": put(self.face_dir, jnp.int8),
@@ -372,9 +371,7 @@ class Advection:
         # validity of the face *below* plane g = validity of the face above
         # plane g-1
         zface_dn = np.roll(zface_up.reshape(-1), 1).reshape(D, nzl)
-        put = lambda a: jax.device_put(
-            jnp.asarray(a, dtype), shard_spec(mesh, np.ndim(a))
-        )
+        put = lambda a: put_table(a, mesh, dtype)
         zf_up_dev, zf_dn_dev = put(zface_up), put(zface_dn)
         mx = jnp.asarray(mask_x, dtype)[None, None, :]
         my = jnp.asarray(mask_y, dtype)[None, :, None]
@@ -693,7 +690,7 @@ class Advection:
         """Layout-aware per-cell read (dense or row layout)."""
         if self.dense is not None:
             d, zl, y, x = self._dense_coords(ids)
-            return np.asarray(state[field])[d, zl, y, x]
+            return fetch(state[field])[d, zl, y, x]
         return self.grid.get_cell_data(state, field, ids)
 
     def set_cell_data(self, state, field: str, ids, values):
@@ -701,7 +698,7 @@ class Advection:
             from ..parallel.mesh import shard_spec
 
             d, zl, y, x = self._dense_coords(ids)
-            host = np.array(state[field])
+            host = fetch(state[field]).copy()
             host[d, zl, y, x] = values
             return {
                 **state,
@@ -853,8 +850,8 @@ class Advection:
 
     def total_mass(self, state) -> float:
         if self.dense is not None:
-            return float(np.asarray(state["density"], dtype=np.float64).sum() * self._vol)
-        rho = np.asarray(state["density"])
+            return float(fetch(state["density"], dtype=np.float64).sum() * self._vol)
+        rho = fetch(state["density"])
         vol = 1.0 / np.where(self.inv_volume > 0, self.inv_volume, np.inf)
         local = np.asarray(self.tables.local_mask)
         return float((rho * vol * local).sum())

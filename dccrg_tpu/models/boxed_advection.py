@@ -57,7 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import SHARD_AXIS, shard_spec
+from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
 
 __all__ = ["build_boxed_run"]
 
@@ -521,8 +521,7 @@ def build_boxed_run(adv, layout):
         return out[None]
 
     statics_dev = [
-        {k: jax.device_put(jnp.asarray(v), shard_spec(mesh, v.ndim))
-         for k, v in s.items()}
+        {k: put_table(v, mesh) for k, v in s.items()}
         for s in statics
     ]
     st_specs = [

@@ -113,7 +113,7 @@ class GameOfLife:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.mesh import SHARD_AXIS, shard_spec
+        from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
 
         grid = self.grid
         epoch = grid.epoch
@@ -128,9 +128,7 @@ class GameOfLife:
         nri, nvi = hood.nbr_rows[ar, irows], hood.nbr_valid[ar, irows]
         nro, nvo = hood.nbr_rows[ar, orows], hood.nbr_valid[ar, orows]
         mesh = grid.mesh
-        put = lambda a: jax.device_put(
-            jnp.asarray(a), shard_spec(mesh, np.ndim(a))
-        )
+        put = lambda a: put_table(a, mesh)
         tabs = tuple(put(a) for a in (irows, orows, nri, nvi, nro, nvo))
         local = put(epoch.local_mask)
         send_rows, recv_rows = halo.send_rows, halo.recv_rows

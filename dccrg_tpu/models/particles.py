@@ -25,8 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import SHARD_AXIS, shard_spec
+from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
 from ..parallel.stencil import StencilTables
+from ..utils.collectives import fetch
 
 __all__ = ["Particles"]
 
@@ -212,9 +213,7 @@ class Particles:
             out_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec()),
             check_vma=False,
         )
-        local_arr = jax.device_put(
-            jnp.asarray(local_rows, jnp.int32), shard_spec(grid.mesh, 2)
-        )
+        local_arr = put_table(local_rows, grid.mesh, jnp.int32)
 
         @jax.jit
         def rebucket_fn(state):
@@ -305,8 +304,8 @@ class Particles:
     def positions(self, state) -> np.ndarray:
         """All particles of local cells, (M, 3), in (device, row, slot)
         order — one boolean gather, no per-row Python."""
-        pos = np.asarray(state["particles"])
-        cnt = np.asarray(state["number_of_particles"])
+        pos = fetch(state["particles"])
+        cnt = fetch(state["number_of_particles"])
         local = np.asarray(self.tables.local_mask)
         valid = (
             np.arange(self.P)[None, None, :] < cnt[..., None]
@@ -314,15 +313,15 @@ class Particles:
         return pos[valid]
 
     def count(self, state) -> int:
-        cnt = np.asarray(state["number_of_particles"])
+        cnt = fetch(state["number_of_particles"])
         return int((cnt * np.asarray(self.tables.local_mask)).sum())
 
     def particles_of(self, state, cell) -> np.ndarray:
         pos = int(self.grid.leaves.position(np.uint64(cell)))
         d = int(self.grid.leaves.owner[pos])
         r = int(self.grid.epoch.row_of[pos])
-        n = int(np.asarray(state["number_of_particles"])[d, r])
-        return np.asarray(state["particles"])[d, r, :n]
+        n = int(fetch(state["number_of_particles"])[d, r])
+        return fetch(state["particles"])[d, r, :n]
 
     def remap(self, state):
         """Carry particles across a structural change (AMR or load

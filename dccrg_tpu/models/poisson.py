@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.stencil import StencilTables, gather_neighbors, ordered_sum
+from ..utils.collectives import fetch
 
 __all__ = ["Poisson"]
 
@@ -212,11 +213,9 @@ class Poisson:
             type_rows[d, : len(lp)] = types[lp]
             type_rows[d, len(lp) : len(lp) + len(gp)] = types[gp]
 
-        from ..parallel.mesh import shard_spec
+        from ..parallel.mesh import put_table
 
-        put = lambda a: jax.device_put(
-            jnp.asarray(a, self.dtype), shard_spec(self.grid.mesh, np.ndim(a))
-        )
+        put = lambda a: put_table(a, self.grid.mesh, self.dtype)
         self._scaling = put(scaling_rows)
         # the [D, R, K] multiplier tables are only uploaded when the
         # gather path actually runs (solver fallback or residual()); when
@@ -228,9 +227,7 @@ class Poisson:
         solve_rows = np.asarray(self.tables.local_mask) & (
             type_rows == self.SOLVE_CELL
         )
-        self._solve_mask = jax.device_put(
-            jnp.asarray(solve_rows), shard_spec(self.grid.mesh, 2)
-        )
+        self._solve_mask = put_table(solve_rows, self.grid.mesh)
         # leaf-level factors kept for the flat dense fast path
         # (ops/flat_poisson.py): per-(leaf, axis) side factors + diagonal
         self._f_pos_leaf = f_pos
@@ -247,11 +244,10 @@ class Poisson:
         if self._mult_dev is None:
             self._mult_dev = [None, None]
         if self._mult_dev[i] is None:
-            from ..parallel.mesh import shard_spec
+            from ..parallel.mesh import put_table
 
-            self._mult_dev[i] = jax.device_put(
-                jnp.asarray(self._mult_np[i], self.dtype),
-                shard_spec(self.grid.mesh, 3),
+            self._mult_dev[i] = put_table(
+                self._mult_np[i], self.grid.mesh, self.dtype
             )
         return self._mult_dev[i]
 
@@ -379,5 +375,5 @@ class Poisson:
 
     def residual(self, state) -> float:
         Ax, _ = self._apply(state["solution"], self._mult_table(0))
-        r = np.asarray(jnp.where(self._solve_mask, state["rhs"] - Ax, 0.0))
+        r = fetch(jnp.where(self._solve_mask, state["rhs"] - Ax, 0.0))
         return float(np.sqrt((r * r).sum()))

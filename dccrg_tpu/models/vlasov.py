@@ -27,6 +27,7 @@ import numpy as np
 
 from ..parallel.dense import HaloExtend
 from ..parallel.mesh import SHARD_AXIS, shard_spec
+from ..utils.collectives import fetch
 
 __all__ = ["Vlasov"]
 
@@ -164,7 +165,7 @@ class Vlasov:
 
     def density(self, state) -> np.ndarray:
         """Velocity-space integral per spatial cell, [D, nzl, ny, nx]."""
-        return np.asarray(state["f"], dtype=np.float64).sum(axis=-1)
+        return fetch(state["f"], dtype=np.float64).sum(axis=-1)
 
     def total_mass(self, state) -> float:
         l0 = self.grid.geometry.get_level_0_cell_length()

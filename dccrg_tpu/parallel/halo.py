@@ -18,10 +18,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, put_table
 
 __all__ = ["HaloExchange", "HaloHandle"]
 
@@ -50,9 +50,15 @@ class HaloExchange:
         self.mesh = mesh
         self.D = epoch.n_devices
         self.R = epoch.R
-        spec3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
-        self.send_rows = jax.device_put(jnp.asarray(hood.send_rows), spec3)
-        self.recv_rows = jax.device_put(jnp.asarray(hood.recv_rows), spec3)
+        # single-controller: sharded device arrays (no per-call transfer
+        # on the TPU hot path).  multi-controller: host numpy — workload
+        # steps jit-wrap the exchange, so the tables are captured
+        # TRANSITIVELY by those outer traces, and closing over another
+        # process's device array is rejected; numpy constants embed
+        # freely.  The cost is a per-dispatch transfer of the (small)
+        # tables only under many controllers.
+        self.send_rows = put_table(hood.send_rows, mesh)
+        self.recv_rows = put_table(hood.recv_rows, mesh)
         #: cells moved per exchange (for bandwidth accounting)
         self.cells_moved = int(hood.pair_counts.sum())
         self._fn = self._build()
@@ -100,7 +106,10 @@ class HaloExchange:
             out_specs=data_spec,
             check_vma=False,
         )
-        return jax.jit(lambda state: fn(self.send_rows, self.recv_rows, state))
+        # schedule tables enter as jit ARGUMENTS, not closed-over
+        # constants: closing over an array that spans other controllers'
+        # devices is rejected under multi-process SPMD
+        return jax.jit(fn)
 
     def __call__(self, state):
         if isinstance(state, HaloHandle):
@@ -108,7 +117,7 @@ class HaloExchange:
                 "got a HaloHandle where a state pytree belongs — pass the "
                 "handle as wait_remote_neighbor_copy_updates(state, handle)"
             )
-        return self._fn(state)
+        return self._fn(self.send_rows, self.recv_rows, state)
 
     # ------------------------------------------------------- split-phase
 
@@ -151,10 +160,8 @@ class HaloExchange:
             out_specs=data_spec,
             check_vma=False,
         )
-        self._start_fn = jax.jit(lambda state: start(self.send_rows, state))
-        self._finish_fn = jax.jit(
-            lambda state, payload: finish(self.recv_rows, state, payload)
-        )
+        self._start_fn = jax.jit(start)
+        self._finish_fn = jax.jit(finish)
 
     def start(self, state) -> HaloHandle:
         """Dispatch the ghost-payload collective; returns a ``HaloHandle``
@@ -163,7 +170,7 @@ class HaloExchange:
             raise TypeError("start() takes the state, not a HaloHandle")
         if not hasattr(self, "_start_fn"):
             self._build_split()
-        return HaloHandle(self._start_fn(state))
+        return HaloHandle(self._start_fn(self.send_rows, state))
 
     def finish(self, state, handle: HaloHandle):
         """Merge a ``start`` handle's payload into the ghost rows."""
@@ -173,7 +180,7 @@ class HaloExchange:
             )
         if not hasattr(self, "_finish_fn"):
             self._build_split()
-        return self._finish_fn(state, handle.payload)
+        return self._finish_fn(self.recv_rows, state, handle.payload)
 
     def bytes_moved(self, state) -> int:
         """Total payload bytes crossing the mesh per exchange."""

@@ -11,7 +11,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "shard_spec", "SHARD_AXIS"]
+__all__ = ["make_mesh", "shard_spec", "put_table", "SHARD_AXIS"]
 
 SHARD_AXIS = "shard"
 
@@ -28,3 +28,27 @@ def make_mesh(devices=None, n_devices: int | None = None) -> Mesh:
 def shard_spec(mesh: Mesh, ndim: int) -> NamedSharding:
     """Sharding that splits the leading (device) axis of a [D, ...] array."""
     return NamedSharding(mesh, P(SHARD_AXIS, *([None] * (ndim - 1))))
+
+
+def put_table(a, mesh: Mesh, dtype=None):
+    """Ship a precomputed schedule/gather/mask table for jitted kernels.
+
+    Single-controller: a device array sharded on the leading axis, so the
+    hot path never re-transfers it.  Multi-controller
+    (``jax.distributed``): the host numpy value — jitted code may embed a
+    replicated numpy constant freely, while closing over a device array
+    that spans other processes' devices is rejected by JAX.  Every
+    controller computes identical tables (the replicated-metadata
+    invariant), so the embedded constants agree.
+    """
+    arr = np.asarray(a) if dtype is None else np.asarray(a, dtype=dtype)
+    if jax.process_count() > 1:
+        # match the device branch's dtype canonicalization (f64 -> f32
+        # when x64 is off) so host-side consumers of the table compute
+        # at the same precision under every controller layout
+        return arr.astype(
+            jax.dtypes.canonicalize_dtype(arr.dtype), copy=False
+        )
+    import jax.numpy as jnp
+
+    return jax.device_put(jnp.asarray(arr), shard_spec(mesh, arr.ndim))

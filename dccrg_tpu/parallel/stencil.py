@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .mesh import shard_spec
+from .mesh import put_table, shard_spec
 
 __all__ = ["StencilTables", "gather_neighbors", "compact_rows"]
 
@@ -70,7 +70,7 @@ class StencilTables:
         epoch = grid.epoch
         hood = epoch.hoods[hood_id]
         mesh = grid.mesh
-        put = lambda a: jax.device_put(jnp.asarray(a), shard_spec(mesh, np.ndim(a)))
+        put = lambda a: put_table(a, mesh)
         self.nbr_rows = put(hood.nbr_rows)
         self.nbr_valid = put(hood.nbr_valid)
         self.nbr_offset = put(hood.nbr_offset)

@@ -28,6 +28,7 @@ import numpy as np
 
 __all__ = [
     "process_count",
+    "fetch",
     "allgather_u64",
     "allgather_u64_multi",
     "union_u64",
@@ -45,6 +46,25 @@ def process_count() -> int:
     import jax
 
     return jax.process_count()
+
+
+def fetch(x, dtype=None) -> np.ndarray:
+    """Device→host readback valid under any controller layout.
+
+    Single-controller arrays (and replicated jit outputs) are fully
+    addressable and convert directly; an array sharded across *other
+    processes'* devices is first all-gathered to every host
+    (``process_allgather(tiled=True)`` lowers to one XLA all_gather),
+    matching the reference's rule that host-side consumers only ever see
+    replicated data (``dccrg.hpp:7196``'s directory invariant).
+    """
+    if getattr(x, "is_fully_addressable", True):
+        out = np.asarray(x)
+    else:
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return out if dtype is None else out.astype(dtype, copy=False)
 
 
 def _process_allgather(x: np.ndarray) -> np.ndarray:

@@ -12,6 +12,7 @@ debugging sessions; it is pure host-side numpy.
 from __future__ import annotations
 
 import numpy as np
+from .collectives import fetch
 
 __all__ = ["verify_grid", "verify_user_data"]
 
@@ -98,13 +99,13 @@ def verify_user_data(grid, state, spec, hood_id=None) -> None:
     match the spec."""
     epoch = grid.epoch
     for name, (shape, dt) in spec.items():
-        arr = np.asarray(state[name])
+        arr = fetch(state[name])
         assert arr.shape[:2] == (grid.n_devices, epoch.R), name
         assert arr.shape[2:] == tuple(shape), name
 
     refreshed = grid.update_copies_of_remote_neighbors(state, hood_id)
     for name in spec:
-        arr = np.asarray(refreshed[name])
+        arr = fetch(refreshed[name])
         for d in range(grid.n_devices):
             gp = epoch.ghost_pos[d]
             if not len(gp):
