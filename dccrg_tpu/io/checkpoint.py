@@ -80,8 +80,9 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
     cell ``i`` (reference: runtime-switched ``get_mpi_datatype``,
     ``tests/particles/cell.hpp:50-84``).
     """
+    from ..utils.collectives import barrier
+
     cells = grid.get_cells()
-    mapping, topo, geom = grid.mapping, grid.topology, grid.geometry
     fixed, ragged_fields = _field_layout(spec, ragged)
 
     per_cell = {}
@@ -103,6 +104,31 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
         bytes_per_cell += counts[name] * row_nb
     offsets = np.concatenate(([0], np.cumsum(bytes_per_cell[:-1])))
 
+    # multi-controller IO fan-in: the readbacks above are COLLECTIVE
+    # (fetch all_gathers each field), so every controller runs them and
+    # holds the identical file content; process 0 alone writes the file
+    # (the reference's collective MPI-IO reduces to one writer once data
+    # is replicated), and the closing barrier — reached even when the
+    # write raises, so peers are never left hung — keeps peers from
+    # racing a subsequent load on shared storage.
+    import jax
+
+    if jax.process_index() != 0:
+        barrier("dccrg_ckpt_save:" + path)
+        return
+    try:
+        _write_checkpoint(path, grid, cells, spec, user_header, fixed,
+                          ragged_fields, per_cell, counts, bytes_per_cell,
+                          offsets)
+    finally:
+        barrier("dccrg_ckpt_save:" + path)
+
+
+def _write_checkpoint(path, grid, cells, spec, user_header, fixed,
+                      ragged_fields, per_cell, counts, bytes_per_cell,
+                      offsets) -> None:
+    mapping, topo, geom = grid.mapping, grid.topology, grid.geometry
+    fixed_bpc = sum(nb for _, _, _, nb in fixed)
     with open(path, "wb") as f:
         f.write(struct.pack("<I", len(user_header)))
         f.write(user_header)

@@ -34,6 +34,7 @@ __all__ = [
     "union_u64",
     "sync_adaptation",
     "sync_partition_inputs",
+    "barrier",
     "all_gather",
     "all_reduce",
     "some_reduce",
@@ -181,6 +182,17 @@ def sync_partition_inputs(pin_requests: dict, cell_weights: dict) -> tuple:
         for c, w in zip(row[2], row[3].view(np.float64)):
             merged_weights[int(c)] = float(w)
     return merged_pins, merged_weights
+
+
+def barrier(name: str = "dccrg") -> None:
+    """Cross-controller synchronization point (the role of
+    ``MPI_Barrier`` around the reference's collective file IO,
+    ``dccrg.hpp:1128``).  Identity with one controller."""
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 def all_gather(per_device_values) -> list:

@@ -132,6 +132,31 @@ def main() -> None:
         ),
     }
 
+    # ---- scenario 5: checkpoint fan-in + reload under 2 controllers --
+    # save runs its collective readbacks on every controller but only
+    # process 0 writes the file; both controllers then reload it and
+    # must see the same grid + payloads as the live state.
+    import tempfile
+
+    ckpt = os.path.join(tempfile.gettempdir(), f"mp_ckpt_{port}.dc")
+    from dccrg_tpu.io.checkpoint import load_grid_data, save_grid_data
+    from dccrg_tpu.utils.collectives import barrier
+
+    if pid == 0 and os.path.exists(ckpt):
+        os.unlink(ckpt)  # a stale file must not mask a save regression
+    save_grid_data(g2, st2, ckpt, spec, user_header=b"mp-test")
+    g3, st3b, hdr = load_grid_data(ckpt, spec)
+    assert hdr == b"mp-test"
+    assert np.array_equal(np.sort(g3.leaves.cells), np.sort(g2.leaves.cells))
+    live = g2.get_cell_data(st2, "rho", np.sort(g2.leaves.cells))
+    reloaded = g3.get_cell_data(st3b, "rho", np.sort(g2.leaves.cells))
+    assert np.array_equal(live, reloaded), "checkpoint round trip differs"
+    res["ckpt"] = {"rho_hash": _hash(reloaded),
+                   "file_exists": os.path.exists(ckpt)}
+    barrier("ckpt_asserts_done")  # peers finish reading before cleanup
+    if pid == 0:
+        os.unlink(ckpt)
+
     print("RESULT " + json.dumps(res), flush=True)
 
 
