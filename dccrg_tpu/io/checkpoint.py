@@ -80,7 +80,7 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
     cell ``i`` (reference: runtime-switched ``get_mpi_datatype``,
     ``tests/particles/cell.hpp:50-84``).
     """
-    from ..utils.collectives import barrier
+    from ..utils.collectives import allgather_u64, process_count
 
     cells = grid.get_cells()
     fixed, ragged_fields = _field_layout(spec, ragged)
@@ -108,27 +108,41 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
     # (fetch all_gathers each field), so every controller runs them and
     # holds the identical file content; process 0 alone writes the file
     # (the reference's collective MPI-IO reduces to one writer once data
-    # is replicated), and the closing barrier — reached even when the
-    # write raises, so peers are never left hung — keeps peers from
-    # racing a subsequent load on shared storage.
+    # is replicated).  The write goes to a temp file + rename so a failed
+    # write never leaves a truncated checkpoint at the final path, and
+    # the closing flag exchange — one allgather every process reaches
+    # even when the write raises — both orders peers behind the write
+    # and tells them whether it succeeded, so a writer-side OSError
+    # surfaces as an error on EVERY controller.
     import jax
 
-    if jax.process_index() != 0:
-        barrier("dccrg_ckpt_save:" + path)
-        return
-    try:
-        _write_checkpoint(path, grid, cells, spec, user_header, fixed,
-                          ragged_fields, per_cell, counts, bytes_per_cell,
-                          offsets)
-    finally:
-        barrier("dccrg_ckpt_save:" + path)
+    err = None
+    if jax.process_index() == 0:
+        try:
+            import os
+
+            tmp = path + ".tmp"
+            _write_checkpoint(tmp, grid, cells, spec, user_header, fixed,
+                              ragged_fields, per_cell, counts,
+                              bytes_per_cell, offsets, fixed_bpc)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            err = e
+    if process_count() > 1:
+        ok = allgather_u64(np.array([0 if err is not None else 1],
+                                    dtype=np.uint64))
+        if err is None and int(ok[0][0]) == 0:
+            raise RuntimeError(
+                f"checkpoint write of {path!r} failed on process 0"
+            )
+    if err is not None:
+        raise err
 
 
 def _write_checkpoint(path, grid, cells, spec, user_header, fixed,
                       ragged_fields, per_cell, counts, bytes_per_cell,
-                      offsets) -> None:
+                      offsets, fixed_bpc) -> None:
     mapping, topo, geom = grid.mapping, grid.topology, grid.geometry
-    fixed_bpc = sum(nb for _, _, _, nb in fixed)
     with open(path, "wb") as f:
         f.write(struct.pack("<I", len(user_header)))
         f.write(user_header)

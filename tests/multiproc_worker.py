@@ -1,7 +1,7 @@
 """Worker process for the REAL multi-controller test.
 
 Launched by ``tests/test_multiprocess.py`` as N separate OS processes,
-each a JAX controller of its own 4 CPU devices in one 4N-device global
+each a JAX controller of its own block of CPU devices in one global
 mesh (``jax.distributed.initialize`` + gloo CPU collectives).  This is
 the deployment shape the reference reaches with one MPI rank per node
 (``dccrg.hpp:7622-7687``): every controller holds the replicated leaf
@@ -26,6 +26,7 @@ def _hash(arr) -> str:
 
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    dpp = int(sys.argv[4]) if len(sys.argv) > 4 else 4  # devices/process
     os.environ.setdefault("GLOO_SOCKET_IFNAME", "lo")
     import jax
 
@@ -44,7 +45,7 @@ def main() -> None:
     from dccrg_tpu.utils.verify import verify_grid, verify_user_data
 
     assert process_count() == nproc
-    assert len(jax.devices()) == 4 * nproc
+    assert len(jax.devices()) == dpp * nproc
     res = {"nproc": nproc, "n_devices": len(jax.devices())}
 
     # ---- scenario 1: game of life across the process boundary --------
@@ -109,9 +110,10 @@ def main() -> None:
     res["ghost"] = "ok"
 
     # ---- scenario 4: balance_load with per-controller pins -----------
-    # controller 0 pins the first leaf to the last device, controller 1
-    # pins the last leaf to device 0; sync_partition_inputs must merge
-    # both so every controller computes the same partition.
+    # controller 0 pins the first leaf to the last device; every other
+    # controller pins the last leaf to device 0 (identical duplicates —
+    # merge-safe); sync_partition_inputs must merge the requests so all
+    # controllers compute the same partition.
     first, last = int(ids[0]), int(ids[-1])
     if pid == 0:
         assert g2.pin(first, g2.n_devices - 1)
@@ -132,7 +134,7 @@ def main() -> None:
         ),
     }
 
-    # ---- scenario 5: checkpoint fan-in + reload under 2 controllers --
+    # ---- scenario 5: checkpoint fan-in + reload across controllers --
     # save runs its collective readbacks on every controller but only
     # process 0 writes the file; both controllers then reload it and
     # must see the same grid + payloads as the live state.
