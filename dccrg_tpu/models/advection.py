@@ -247,8 +247,10 @@ class Advection:
             build_flat_amr_sharded,
             build_flat_amr_tables,
             compute_flat_weights,
+            flat_amr_fits,
             make_flat_amr_run,
             make_flat_amr_run_sharded,
+            pad_lane_extent,
         )
 
         # use_pallas doubles as the fast-path opt-out: False always means
@@ -282,7 +284,15 @@ class Advection:
         nz1, ny1, nx1 = t["shape"]
         self._flat_n_vox = nz1 * ny1 * nx1
         self._flat_kind = "pallas_interpret" if interpret else "pallas"
-        kernel = make_flat_amr_run(nz1, ny1, nx1, interpret=interpret)
+        # lane-align the x extent when the pad fits VMEM: Mosaic pads
+        # registers to 128 lanes regardless, so the explicit pad costs no
+        # extra compute and turns the 12 per-step x rolls lane-aligned
+        nxp = pad_lane_extent(nx1)
+        if nxp != nx1 and not flat_amr_fits(nz1 * ny1 * nxp):
+            nxp = nx1
+        self._flat_nx_pad = nxp if nxp != nx1 else None
+        kernel = make_flat_amr_run(nz1, ny1, nx1, nx_pad=self._flat_nx_pad,
+                                   interpret=interpret)
         rows = jnp.asarray(t["rows"])
         leaf = t["leaf_fine"]
         updf = jnp.asarray(leaf.astype(np.float64) / t["vol_f"], jnp.float32)

@@ -57,6 +57,7 @@ __all__ = [
     "flat_voxel_layout",
     "build_flat_amr_sharded",
     "make_flat_amr_run_sharded",
+    "pad_lane_extent",
 ]
 
 #: VMEM cap: ~18 resident arrays (ping/pong state, 6 weights, 2 update
@@ -67,6 +68,25 @@ _FLAT_ARRAYS = 18
 
 def flat_amr_fits(n_voxels: int) -> bool:
     return _FLAT_ARRAYS * n_voxels * 4 <= _FLAT_VMEM_BUDGET
+
+
+#: TPU vector lane width: the last-dim extent Mosaic tiles registers by
+_LANE = 128
+
+
+def pad_lane_extent(nx1: int, max_factor: float = 1.5) -> int:
+    """Physical lane (x) extent for the padded flat kernel: the smallest
+    multiple of 128 holding ``nx1`` real columns plus the two halo columns
+    the periodic wrap needs.  An x extent that is not lane-aligned makes
+    Mosaic pad every register to 128 lanes anyway AND lowers the per-step
+    x rolls as unaligned cross-lane shuffles — so when the memory cost is
+    modest (``<= max_factor * nx1``) spending the pad explicitly buys
+    aligned rolls.  Returns ``nx1`` unchanged when already aligned or when
+    padding would inflate memory beyond ``max_factor``."""
+    if nx1 % _LANE == 0:
+        return nx1
+    nxp = ((nx1 + 2 + _LANE - 1) // _LANE) * _LANE
+    return nxp if nxp <= max_factor * nx1 else nx1
 
 
 def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
@@ -257,6 +277,7 @@ def compute_flat_weights(tables, VX, VY, VZ, dtype=jnp.float32):
 
 
 def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
+                      nx_pad: int | None = None,
                       interpret: bool = False):
     """Returns ``run(V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c, dt,
     steps) -> V'`` advancing the flat two-level grid ``steps`` timesteps
@@ -271,19 +292,34 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
     premultiply never drives intermediates toward the f32 subnormal
     range the way scaling the ~1/vol update constants would).
 
+    ``nx_pad`` (from :func:`pad_lane_extent`): physical lane extent.
+    When larger than ``nx1``, the arrays carry ``nx_pad - nx1`` extra x
+    columns so every x roll is lane-aligned: column ``nx1`` is a +x halo
+    holding column 0's value and column ``nx_pad-1`` is a -x halo holding
+    column ``nx1-1``'s, so the two wrap-face fluxes read the same operand
+    values as the unpadded rolls and the update stays BIT-identical;
+    interior pad columns carry weight 0 everywhere and never update.  The
+    halo columns are refreshed at the end of each step (two lane-slice
+    selects — noise next to the 12 rolls they align).  The wrapper takes
+    and returns unpadded arrays either way.
+
     VMEM discipline: weight/mask refs are read inside the step body (the
     reads are transient stack temporaries the allocator reuses) rather
     than hoisted into loop-carried copies — hoisting all six weight
     arrays pushed the scoped-VMEM stack past the 96 MiB default on a
     96^3 voxel grid and forced spills."""
     roll_m1, roll_p1 = _make_rolls(interpret)
+    nxp = nx1 if nx_pad is None else int(nx_pad)
+    if nxp != nx1 and nxp < nx1 + 2:
+        raise ValueError("nx_pad must leave room for the two halo columns")
+    padded = nxp != nx1
 
     def kernel(steps_ref, v_ref, wpx, wnx, wpy, wny, wpz, wnz,
                updf_ref, updc_ref, out_ref, scr_ref):
         steps = steps_ref[0]
         # pool mask = coarse voxels; the roll-chain pool below must only
         # sum coarse deltas, so mask with (updc != 0) — exact since updc
-        # is 0 or 1/vol_c
+        # is 0 or 1/vol_c (pad columns: 0, so pads never pool)
         pool = (updc_ref[...] != 0).astype(jnp.float32)
 
         def one_step(src_ref, dst_ref):
@@ -302,7 +338,9 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
             s = s + roll_m1(s, 1)
             s = s + roll_m1(s, 0)
             # keep origins only (origin = even position on every axis AND
-            # coarse: updc masks fine leaves later; zero odd positions)
+            # coarse: updc masks fine leaves later; zero odd positions —
+            # and, when padded, never a pad column: the -1 x roll above
+            # wraps s[0] into the last pad column)
             s = s * orig
             # broadcast origin values over their blocks: non-origin
             # positions hold 0, so b += roll(+1) duplicates along each
@@ -310,13 +348,21 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
             s = s + roll_p1(s, 2)
             s = s + roll_p1(s, 1)
             s = s + roll_p1(s, 0)
-            dst_ref[...] = v + delta * updf_ref[...] + s * updc_ref[...]
+            res = v + delta * updf_ref[...] + s * updc_ref[...]
+            if padded:
+                # refresh the two wrap halo columns from this step's result
+                res = jnp.where(xi == nx1, res[:, :, 0:1], res)
+                res = jnp.where(xi == nxp - 1, res[:, :, nx1 - 1:nx1], res)
+            dst_ref[...] = res
 
         # origin parity mask, built once from iota (static shapes)
-        ex = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 2) % 2 == 0
-        ey = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 1) % 2 == 0
-        ez = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nx1), 0) % 2 == 0
+        ex = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nxp), 2) % 2 == 0
+        ey = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nxp), 1) % 2 == 0
+        ez = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nxp), 0) % 2 == 0
         orig = (ex & ey & ez).astype(jnp.float32)
+        if padded:
+            xi = jax.lax.broadcasted_iota(jnp.int32, (nz1, ny1, nxp), 2)
+            orig = orig * (xi < nx1).astype(jnp.float32)
 
         out_ref[...] = v_ref[...]
 
@@ -350,17 +396,43 @@ def make_flat_amr_run(nz1: int, ny1: int, nx1: int, *,
         kernel,
         in_specs=[smem] + [vmem] * 9,
         out_specs=vmem,
-        scratch_shapes=[pltpu.VMEM((nz1, ny1, nx1), jnp.float32)],
-        out_shape=jax.ShapeDtypeStruct((nz1, ny1, nx1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((nz1, ny1, nxp), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((nz1, ny1, nxp), jnp.float32),
         interpret=interpret,
         **kwargs,
     )
 
+    def _embed(a, lo=None, hi=None):
+        """Pad ``a`` to nxp x columns: zeros, except column nx1 = ``lo``
+        and column nxp-1 = ``hi`` when given (lane slices of ``a``)."""
+        z = jnp.zeros((nz1, ny1, nxp - nx1), a.dtype)
+        if lo is not None:
+            z = z.at[:, :, 0:1].set(lo)
+        if hi is not None:
+            z = z.at[:, :, -1:].set(hi)
+        return jnp.concatenate([a, z], axis=2)
+
     def run(V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c, dt, steps):
         dt = jnp.asarray(dt, jnp.float32)
         steps_arr = jnp.asarray(steps, jnp.int32).reshape(1)
-        return call(steps_arr, V, wpx * dt, wnx * dt, wpy * dt, wny * dt,
-                    wpz * dt, wnz * dt, upd_f, upd_c)
+        args = (V, wpx * dt, wnx * dt, wpy * dt, wny * dt,
+                wpz * dt, wnz * dt, upd_f, upd_c)
+        if padded:
+            V, wpx, wnx, wpy, wny, wpz, wnz, upd_f, upd_c = args
+            # x-face weights: the wrap face's weight sits at column nx1-1
+            # (pairing it with the +x halo) AND at column nxp-1 (pairing
+            # the -x halo with column 0 via the aligned roll wrap) — each
+            # copy feeds a different cell's delta, exactly the two reads
+            # the unpadded roll pair makes of the single wrap face
+            args = (
+                _embed(V, lo=V[:, :, 0:1], hi=V[:, :, nx1 - 1:nx1]),
+                _embed(wpx, hi=wpx[:, :, nx1 - 1:nx1]),
+                _embed(wnx, hi=wnx[:, :, nx1 - 1:nx1]),
+                _embed(wpy), _embed(wny), _embed(wpz), _embed(wnz),
+                _embed(upd_f), _embed(upd_c),
+            )
+        out = call(steps_arr, *args)
+        return out[:, :, :nx1] if padded else out
 
     return run
 

@@ -23,20 +23,21 @@ import numpy as np
 from dccrg_tpu.ops.flat_amr import make_flat_amr_run
 
 SHAPES = [
-    (96, 96, 96),     # the r02 refined-bench voxel grid (48^3 coarse)
-    (96, 96, 128),    # x lane-aligned, same order of voxels
-    (64, 96, 128),    # x aligned, shallower z
-    (64, 128, 128),   # the dense headline kernel's block shape
-    (128, 128, 128),  # aligned, 2.1M voxels
+    (96, 96, 96),      # the r02 refined-bench voxel grid (48^3 coarse)
+    (96, 96, 96, 128),  # same grid, lane-padded (explicit wrap halos)
+    (96, 96, 128),     # x lane-aligned, same order of voxels
+    (64, 96, 128),     # x aligned, shallower z
+    (64, 128, 128),    # the dense headline kernel's block shape
+    (128, 128, 128),   # aligned, 2.1M voxels
 ]
 STEPS = 1000
 REPS = 5
 
 
-def bench(nz1, ny1, nx1):
+def bench(nz1, ny1, nx1, nx_pad=None):
     n_vox = nz1 * ny1 * nx1
     rng = np.random.default_rng(0)
-    kern = make_flat_amr_run(nz1, ny1, nx1)
+    kern = make_flat_amr_run(nz1, ny1, nx1, nx_pad=nx_pad)
     shape = (nz1, ny1, nx1)
     V = jnp.asarray(rng.random(shape), jnp.float32)
     # synthetic but structurally faithful weights: small CFL-scale values,
@@ -58,8 +59,9 @@ def bench(nz1, ny1, nx1):
         times.append(time.perf_counter() - t0)
     med = statistics.median(times)
     rate = n_vox * STEPS / med
+    pad = f" nx_pad={nx_pad}" if nx_pad else ""
     print(
-        f"shape=({nz1},{ny1},{nx1}) n_vox={n_vox} "
+        f"shape=({nz1},{ny1},{nx1}){pad} n_vox={n_vox} "
         f"med={med:.4f}s rate={rate/1e9:.2f} B voxel-updates/s "
         f"times={[round(t, 4) for t in times]}"
     )

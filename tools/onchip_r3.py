@@ -1,31 +1,68 @@
 #!/usr/bin/env python
-"""One-shot on-chip measurement battery for round 3's new paths.
+"""Incremental on-chip measurement battery (round 3).
 
 Run when the TPU tunnel is up:  python tools/onchip_r3.py
-Writes results incrementally to tools/onchip_r3.json (so a mid-run
-tunnel drop preserves what completed).
+Writes results incrementally to tools/onchip_r3.json; keys that already
+hold a successful result are skipped, so re-running after a mid-battery
+tunnel drop measures only what is still missing.  `--watch` polls the
+tunnel (5 min period) and runs the battery each time it comes up, until
+every key is recorded or the deadline passes.
 """
 import json
 import pathlib
 import subprocess
 import sys
+import time
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 OUT = ROOT / "tools" / "onchip_r3.json"
 
 
+def _load():
+    return json.loads(OUT.read_text()) if OUT.exists() else {}
+
+
+def _ok(value):
+    """A measurement is complete when nothing in it is an error: no
+    "error" key and no string-valued entries (the sweep child records a
+    failed shape as its error string)."""
+    if isinstance(value, dict):
+        return "error" not in value and all(
+            not isinstance(v, str) for v in value.values()
+        )
+    return value is not None
+
+
 def record(key, value):
-    data = json.loads(OUT.read_text()) if OUT.exists() else {}
+    data = _load()
+    prev = data.get(key)
+    if not _ok(value) and isinstance(prev, dict) and isinstance(value, dict):
+        # merge passes: a shape measured on an earlier pass survives a
+        # later pass's tunnel-drop error string for the same shape
+        merged = {k: v for k, v in prev.items() if not isinstance(v, str)}
+        for k, v in value.items():
+            if not isinstance(v, str) or k not in merged:
+                merged[k] = v
+        value = merged
     data[key] = value
     OUT.write_text(json.dumps(data, indent=1))
-    print(f"[onchip] {key}: recorded", flush=True)
+    state = "recorded" if _ok(value) else "INCOMPLETE"
+    print(f"[onchip] {key}: {state}", flush=True)
+
+
+def done(key):
+    return _ok(_load().get(key))
 
 
 def run_child(code, timeout=1500):
     """Each measurement in its own process: a tunnel drop kills one
     measurement, not the battery."""
-    r = subprocess.run([sys.executable, "-c", code], text=True,
-                       capture_output=True, timeout=timeout, cwd=str(ROOT))
+    try:
+        r = subprocess.run([sys.executable, "-c", code], text=True,
+                           capture_output=True, timeout=timeout,
+                           cwd=str(ROOT))
+    except subprocess.TimeoutExpired:
+        return {"error": f"timed out after {timeout}s"}
     line = next((ln for ln in reversed(r.stdout.splitlines())
                  if ln.startswith("{")), None)
     if r.returncode == 0 and line:
@@ -40,10 +77,20 @@ import jax
 import numpy as np
 """ % str(ROOT)
 
-
-def main():
-    # 1. flat kernel shape sweep (lane-alignment question)
-    code = PRELUDE + """
+#: key -> (child code, timeout).  bench.measure_* are the single source
+#: of truth for configurations; each runs alone in a child.
+MEASUREMENTS = {
+    "headline": ("import bench\nprint(json.dumps(bench.measure_tpu()))", 1500),
+    "large": ("import bench\nprint(json.dumps(bench.measure_large()))", 1500),
+    "gol": ("import bench\nprint(json.dumps(bench.measure_gol()))", 1500),
+    "refined_dispatch": (
+        "import bench\nprint(json.dumps(bench.measure_refined()))", 1500),
+    "pic": ("import bench\nprint(json.dumps(bench.measure_pic()))", 1500),
+    "poisson": ("import bench\nprint(json.dumps(bench.measure_poisson()))",
+                1500),
+    "vlasov": ("import bench\nprint(json.dumps(bench.measure_vlasov()))",
+               1500),
+    "flat_kernel_sweep_Bvox_per_s": ("""
 import tools.flat_kernel_bench as fkb
 out = {}
 for shape in fkb.SHAPES:
@@ -52,38 +99,60 @@ for shape in fkb.SHAPES:
     except Exception as e:
         out["x".join(map(str, shape))] = str(e)[-150:]
 print(json.dumps(out))
-"""
-    record("flat_kernel_sweep_Bvox_per_s", run_child(code, 2400))
+""", 2400),
+}
 
-    # 2. GoL fused kernel (bench config)
-    code = PRELUDE + """
-import bench
-print(json.dumps(bench.measure_gol()))
-"""
-    record("gol", run_child(code))
 
-    # 3. refined advection through the current dispatch (boxed preferred)
-    code = PRELUDE + """
-import bench
-print(json.dumps(bench.measure_refined()))
-"""
-    record("refined_dispatch", run_child(code))
+def tunnel_up(timeout=120):
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, jax; "
+             "sys.exit(1 if jax.devices()[0].platform == 'cpu' else 0)"],
+            timeout=timeout, capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
-    # 4. device-side PIC
-    code = PRELUDE + """
-import bench
-print(json.dumps(bench.measure_pic()))
-"""
-    record("pic", run_child(code))
 
-    # 5. flat Poisson (refined + uniform)
-    code = PRELUDE + """
-import bench
-print(json.dumps(bench.measure_poisson()))
-"""
-    record("poisson", run_child(code))
+def battery():
+    for key, (body, timeout) in MEASUREMENTS.items():
+        if done(key):
+            print(f"[onchip] {key}: already recorded, skipping", flush=True)
+            continue
+        record(key, run_child(PRELUDE + body, timeout))
+        if not done(key) and not tunnel_up():
+            print("[onchip] tunnel dropped; stopping this pass", flush=True)
+            return False
+    return all(done(k) for k in MEASUREMENTS)
 
-    print("[onchip] battery complete:", OUT, flush=True)
+
+def main():
+    if "--watch" in sys.argv:
+        i = sys.argv.index("--watch") + 1
+        hours = 8.0
+        if i < len(sys.argv):
+            try:
+                hours = float(sys.argv[i])
+            except ValueError:
+                pass
+        deadline = time.time() + hours * 3600
+        while time.time() < deadline:
+            if all(done(k) for k in MEASUREMENTS):
+                print("[onchip] battery complete:", OUT, flush=True)
+                return
+            if tunnel_up():
+                print("[onchip] tunnel up; running battery", flush=True)
+                if battery():
+                    print("[onchip] battery complete:", OUT, flush=True)
+                    return
+            else:
+                print("[onchip] tunnel down; sleeping", flush=True)
+            time.sleep(300)
+        print("[onchip] watch deadline reached", flush=True)
+        return
+    if battery():
+        print("[onchip] battery complete:", OUT, flush=True)
 
 
 if __name__ == "__main__":
