@@ -215,7 +215,24 @@ class GameOfLife:
             and gol_run_fits(nyl, nx)
             and (interpret or pallas_available(np.float32))
         ):
-            kern = make_gol_run(nyl, nx, px, py, interpret=interpret)
+            from ..ops.flat_amr import pad_extent
+
+            # tile-align both axes when the pad fits VMEM (x: 128 lanes,
+            # y: 8 sublanes) — the reference example's 500x500 board
+            # becomes 504x512 and every per-turn roll is aligned
+            nxp, nyp = pad_extent(nx, 128), pad_extent(nyl, 8)
+            if not gol_run_fits(nyp, nxp):
+                # near the VMEM ceiling: drop the costlier x pad first,
+                # keeping the nearly-free sublane alignment if it fits
+                nxp = nx
+                if not gol_run_fits(nyp, nxp):
+                    nyp = nyl
+            kern = make_gol_run(
+                nyl, nx, px, py,
+                ny_pad=nyp if nyp != nyl else None,
+                nx_pad=nxp if nxp != nx else None,
+                interpret=interpret,
+            )
 
             @jax.jit
             def fused_fn(state, turns):

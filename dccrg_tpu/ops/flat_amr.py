@@ -74,19 +74,24 @@ def flat_amr_fits(n_voxels: int) -> bool:
 _LANE = 128
 
 
+def pad_extent(n: int, unit: int, max_factor: float = 1.5) -> int:
+    """Physical extent for a tile-padded kernel axis: the smallest
+    multiple of ``unit`` holding ``n`` real positions plus the two halo
+    positions the periodic wrap needs.  An extent that is not
+    tile-aligned makes Mosaic pad every register to the tile anyway AND
+    lowers the per-step rolls as unaligned shuffles — so when the memory
+    cost is modest (``<= max_factor * n``) spending the pad explicitly
+    buys aligned rolls.  Returns ``n`` unchanged when already aligned or
+    when padding would inflate memory beyond ``max_factor``."""
+    if n % unit == 0:
+        return n
+    np_ = ((n + 2 + unit - 1) // unit) * unit
+    return np_ if np_ <= max_factor * n else n
+
+
 def pad_lane_extent(nx1: int, max_factor: float = 1.5) -> int:
-    """Physical lane (x) extent for the padded flat kernel: the smallest
-    multiple of 128 holding ``nx1`` real columns plus the two halo columns
-    the periodic wrap needs.  An x extent that is not lane-aligned makes
-    Mosaic pad every register to 128 lanes anyway AND lowers the per-step
-    x rolls as unaligned cross-lane shuffles — so when the memory cost is
-    modest (``<= max_factor * nx1``) spending the pad explicitly buys
-    aligned rolls.  Returns ``nx1`` unchanged when already aligned or when
-    padding would inflate memory beyond ``max_factor``."""
-    if nx1 % _LANE == 0:
-        return nx1
-    nxp = ((nx1 + 2 + _LANE - 1) // _LANE) * _LANE
-    return nxp if nxp <= max_factor * nx1 else nx1
+    """:func:`pad_extent` for the 128-lane (last) axis."""
+    return pad_extent(nx1, _LANE, max_factor)
 
 
 def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
