@@ -428,17 +428,7 @@ class Advection:
                 mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
                 my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
 
-        def halo_stacks(blk, B):
-            """Per-block z-halo planes for the blocked kernel: row k of
-            (lo, hi) holds the plane below/above block k — interior rows
-            are strided slices of blk, the edge rows are the
-            ppermute-received device-boundary planes."""
-            below, above = extend.planes(blk)
-            if nzl // B == 1:
-                return below, above
-            lo = jnp.concatenate([below, blk[B - 1:-1:B]], axis=0)
-            hi = jnp.concatenate([blk[B::B], above], axis=0)
-            return lo, hi
+        halo_stacks = extend.block_stacks
 
         # Negative-side x/y faces: the flux through cell i's negative face
         # equals the positive-side face flux of cell i-1, i.e.

@@ -137,13 +137,16 @@ class Vlasov:
 
             def body_fast(f, dt):
                 f = f[0]
-                below, above = extend.planes(f)
+                lo, hi = extend.block_stacks(f, blk)
                 if not periodic[2]:
+                    # open z: the wrap-received edge planes are vacuum —
+                    # lo's first row (below block 0) on device 0, hi's
+                    # last row (above the last block) on device D-1
                     d = jax.lax.axis_index(SHARD_AXIS)
-                    below = below * jnp.where(d == 0, 0, 1).astype(dtype)
-                    above = above * jnp.where(d == D - 1, 0, 1).astype(dtype)
-                lo = jnp.concatenate([below, f[blk - 1:nzl - 1:blk]], axis=0)
-                hi = jnp.concatenate([f[blk:nzl:blk], above], axis=0)
+                    lo = lo.at[0].multiply(
+                        jnp.where(d == 0, 0, 1).astype(dtype))
+                    hi = hi.at[-1].multiply(
+                        jnp.where(d == D - 1, 0, 1).astype(dtype))
                 return (kern(f, lo, hi, vxb, vyb, vzb, dt)[None],)
 
             body_run = body_fast
