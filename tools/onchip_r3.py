@@ -9,6 +9,7 @@ tunnel (5 min period) and runs the battery each time it comes up, until
 every key is recorded or the deadline passes.
 """
 import json
+import os
 import pathlib
 import subprocess
 import sys
@@ -45,7 +46,12 @@ def record(key, value):
                 merged[k] = v
         value = merged
     data[key] = value
-    OUT.write_text(json.dumps(data, indent=1))
+    # atomic replace: bench.py's fallback path may read this file at any
+    # moment (it is exactly the outage-time evidence), so a truncate+write
+    # must never be observable
+    tmp = OUT.with_suffix(".tmp")
+    tmp.write_text(json.dumps(data, indent=1))
+    os.replace(tmp, OUT)
     state = "recorded" if _ok(value) else "INCOMPLETE"
     print(f"[onchip] {key}: {state}", flush=True)
 
