@@ -700,13 +700,25 @@ def _emit_fallback(diag):
     r8 = measure_multidev_cpu()
     # freshest on-chip evidence: the incremental battery
     # (tools/onchip_r3.py --watch) measures each path in its own child
-    # whenever the tunnel is up and persists results; attach them so an
-    # outage at bench time still reports real measured numbers
+    # whenever the tunnel is up and persists results; attach the keys
+    # that hold complete measurements (not error records) so an outage
+    # at bench time still reports real measured numbers
     battery = None
     bpath = ROOT / "tools" / "onchip_r3.json"
     if bpath.exists():
         try:
-            battery = json.loads(bpath.read_text())
+            raw = json.loads(bpath.read_text())
+            battery = {}
+            for k, v in raw.items():
+                if isinstance(v, dict) and "error" in v:
+                    continue  # failed child: not a measurement
+                if k == "flat_kernel_sweep_Bvox_per_s" and isinstance(v, dict):
+                    # per-shape map: keep the shapes that measured
+                    v = {s: r for s, r in v.items() if not isinstance(r, str)}
+                    if not v:
+                        continue
+                battery[k] = v
+            battery = battery or None
         except Exception:  # noqa: BLE001
             battery = None
     print(json.dumps({

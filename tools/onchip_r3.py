@@ -23,23 +23,33 @@ def _load():
     return json.loads(OUT.read_text()) if OUT.exists() else {}
 
 
-def _ok(value):
-    """A measurement is complete when nothing in it is an error: no
-    "error" key and no string-valued entries (the sweep child records a
-    failed shape as its error string)."""
+#: the one key whose value is a {shape: rate-or-error-string} map — its
+#: completeness and cross-pass merging are per shape
+SWEEP_KEY = "flat_kernel_sweep_Bvox_per_s"
+
+
+def _ok(value, key=None):
+    """A measurement is complete when it is not an error record: no
+    "error" key, and — for the sweep, whose values are per-shape rates
+    or error strings — no string-valued entries.  Regular measurements
+    legitimately contain strings ("path", "device_kind", notes)."""
+    if value is None:
+        return False
     if isinstance(value, dict):
-        return "error" not in value and all(
-            not isinstance(v, str) for v in value.values()
-        )
-    return value is not None
+        if "error" in value:
+            return False
+        if key == SWEEP_KEY:
+            return all(not isinstance(v, str) for v in value.values())
+    return True
 
 
 def record(key, value):
     data = _load()
     prev = data.get(key)
-    if not _ok(value) and isinstance(prev, dict) and isinstance(value, dict):
-        # merge passes: a shape measured on an earlier pass survives a
-        # later pass's tunnel-drop error string for the same shape
+    if (key == SWEEP_KEY and not _ok(value, key)
+            and isinstance(prev, dict) and isinstance(value, dict)):
+        # merge sweep passes: a shape measured on an earlier pass
+        # survives a later pass's tunnel-drop error string
         merged = {k: v for k, v in prev.items() if not isinstance(v, str)}
         for k, v in value.items():
             if not isinstance(v, str) or k not in merged:
@@ -48,16 +58,17 @@ def record(key, value):
     data[key] = value
     # atomic replace: bench.py's fallback path may read this file at any
     # moment (it is exactly the outage-time evidence), so a truncate+write
-    # must never be observable
-    tmp = OUT.with_suffix(".tmp")
+    # must never be observable; pid-unique temp name keeps concurrent
+    # writers (watch daemon + an ad-hoc run) atomic per writer
+    tmp = OUT.with_suffix(f".tmp.{os.getpid()}")
     tmp.write_text(json.dumps(data, indent=1))
     os.replace(tmp, OUT)
-    state = "recorded" if _ok(value) else "INCOMPLETE"
+    state = "recorded" if _ok(value, key) else "INCOMPLETE"
     print(f"[onchip] {key}: {state}", flush=True)
 
 
 def done(key):
-    return _ok(_load().get(key))
+    return _ok(_load().get(key), key)
 
 
 def run_child(code, timeout=1500):
