@@ -250,7 +250,7 @@ def measure_gol() -> dict:
     return {
         "grid": [n, n],
         "turns": GOL_TURNS,
-        "fused_kernel": gol._dense_run is not None,
+        "fused_kernel": gol._fused_run is not None,
         "updates_per_s": n * n * GOL_TURNS / secs,
         "times_s": [round(t, 4) for t in times],
     }

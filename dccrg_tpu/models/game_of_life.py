@@ -69,6 +69,9 @@ class GameOfLife:
             detect_dense2d(grid, hood_id) if allow_dense and not overlap
             else None
         )
+        #: whole-run fused Pallas kernel (set by _build_dense_run when it
+        #: qualifies); _dense_run is the XLA dense loop beneath it
+        self._fused_run = None
         self._dense_run = (
             self._build_dense_run() if self.dense2d is not None else None
         )
@@ -249,7 +252,10 @@ class GameOfLife:
                     "live_neighbor_count": out_c[None],
                 }
 
-            return fused_fn
+            # the Pallas kernel is an optimization over the XLA dense
+            # loop built below — keep both so a TPU-generation Mosaic
+            # rejection at first call can fall back (see run())
+            self._fused_run = fused_fn
         # x-wrap validity columns: neighbor at x+1 invalid for x = nx-1 on
         # open x; at x-1 invalid for x = 0
         vx_hi = np.ones(nx, np.uint32)
@@ -317,6 +323,15 @@ class GameOfLife:
         async pipelines of collective programs trip XLA:CPU's rendezvous
         watchdog on oversubscribed hosts (virtual-device meshes), and a
         depth-16 pipeline already hides dispatch latency on real chips."""
+        if self._fused_run is not None and turns > 0:
+            try:
+                return self._fused_run(state, jnp.asarray(turns, jnp.int32))
+            except Exception as e:  # noqa: BLE001 - Mosaic compile rejection
+                import sys
+
+                print(f"fused GoL kernel disabled ({e!r:.200}); "
+                      "using the XLA dense loop", file=sys.stderr)
+                self._fused_run = None
         if self._dense_run is not None and turns > 0:
             return self._dense_run(state, jnp.asarray(turns, jnp.int32))
         for i in range(turns):
