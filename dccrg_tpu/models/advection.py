@@ -28,6 +28,7 @@ import numpy as np
 from ..parallel.mesh import put_table, shard_spec
 from ..parallel.stencil import StencilTables, gather_neighbors, ordered_sum
 from ..utils.collectives import fetch
+from ..utils.fallback import fallback_call
 
 __all__ = ["Advection"]
 
@@ -729,20 +730,28 @@ class Advection:
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if getattr(self, "_flat_run", None) is not None:
-            try:
-                return self._flat_run(
+            # the flat kernel is an optimization; if the TPU compiler
+            # rejects it (op support varies by generation), fall back to
+            # the boxed/general dispatch permanently for this instance —
+            # but only after the fallback succeeds on the same inputs
+            # (utils/fallback.py's policy), so a caller error propagates
+            return fallback_call(
+                "flat AMR kernel",
+                lambda: self._flat_run(
                     state, jnp.asarray(steps, jnp.int32),
                     jnp.asarray(dt, self.dtype),
-                )
-            except Exception as e:  # noqa: BLE001 - Mosaic compile rejection
-                # the flat kernel is an optimization; if the TPU compiler
-                # rejects it (op support varies by generation), fall back
-                # to the boxed path permanently for this model instance
-                import sys
+                ),
+                lambda: self._run_general(state, steps, dt),
+                self._disable_flat,
+            )
+        return self._run_general(state, steps, dt)
 
-                print(f"flat AMR kernel disabled ({e!r:.200}); "
-                      "using the boxed path", file=sys.stderr)
-                self._flat_run = None
+    def _disable_flat(self):
+        self._flat_run = None
+
+    def _run_general(self, state, steps, dt):
+        """The non-flat whole-run dispatch: boxed, dense, or the general
+        gather-path fori_loop."""
         if getattr(self, "_boxed_run", None) is not None:
             return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)

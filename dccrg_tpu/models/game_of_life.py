@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.stencil import StencilTables, compact_rows, gather_neighbors
+from ..utils.fallback import fallback_call
 
 __all__ = ["GameOfLife"]
 
@@ -313,6 +314,9 @@ class GameOfLife:
 
         return run_fn
 
+    def _disable_fused(self):
+        self._fused_run = None
+
     def step(self, state):
         return self._step(state)
 
@@ -324,14 +328,10 @@ class GameOfLife:
         watchdog on oversubscribed hosts (virtual-device meshes), and a
         depth-16 pipeline already hides dispatch latency on real chips."""
         if self._fused_run is not None and turns > 0:
-            try:
-                return self._fused_run(state, jnp.asarray(turns, jnp.int32))
-            except Exception as e:  # noqa: BLE001 - Mosaic compile rejection
-                import sys
-
-                print(f"fused GoL kernel disabled ({e!r:.200}); "
-                      "using the XLA dense loop", file=sys.stderr)
-                self._fused_run = None
+            return fallback_call(
+                "fused GoL kernel", self._fused_run, self._dense_run,
+                self._disable_fused, state, jnp.asarray(turns, jnp.int32),
+            )
         if self._dense_run is not None and turns > 0:
             return self._dense_run(state, jnp.asarray(turns, jnp.int32))
         for i in range(turns):

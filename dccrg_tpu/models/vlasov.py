@@ -28,6 +28,7 @@ import numpy as np
 from ..parallel.dense import HaloExtend
 from ..parallel.mesh import SHARD_AXIS, shard_spec
 from ..utils.collectives import fetch
+from ..utils.fallback import fallback_call
 
 __all__ = ["Vlasov"]
 
@@ -106,9 +107,11 @@ class Vlasov:
             return (f[None],)
 
         # ---- blocked fused Pallas step (ops/vlasov_kernel.py): all three
-        # dimension splits in one HBM pass, bit-identical to `body`
+        # dimension splits in one HBM pass, bit-identical to `body`.  An
+        # optimization layered over the always-built XLA step: a Mosaic
+        # rejection at first call disables it for the instance (the
+        # flat-AMR / fused-GoL fallback pattern)
         self._fused_block = 0
-        body_run = body
         from ..ops.dense_advection import have_pallas, pallas_available
         from ..ops.vlasov_kernel import (
             make_vlasov_step_blocked,
@@ -118,6 +121,7 @@ class Vlasov:
         interpret = self.use_pallas == "interpret"
         nzl, ny, nx, B = info.nz_local, info.ny, info.nx, self.B
         blk = pick_vlasov_block(nzl, ny, nx, B)
+        body_fast = None
         if (
             self.use_pallas
             and have_pallas()
@@ -149,29 +153,38 @@ class Vlasov:
                         jnp.where(d == D - 1, 0, 1).astype(dtype))
                 return (kern(f, lo, hi, vxb, vyb, vzb, dt)[None],)
 
-            body_run = body_fast
+        def make_pair(b):
+            fn = shard_map(
+                b,
+                mesh=mesh,
+                in_specs=(data_spec, P()),
+                out_specs=(data_spec,),
+                check_vma=False,
+            )
 
-        fn = shard_map(
-            body_run,
-            mesh=mesh,
-            in_specs=(data_spec, P()),
-            out_specs=(data_spec,),
-            check_vma=False,
-        )
+            @jax.jit
+            def step(state, dt):
+                (f,) = fn(state["f"], jnp.asarray(dt, dtype))
+                return {"f": f}
 
-        @jax.jit
-        def step(state, dt):
-            (f,) = fn(state["f"], jnp.asarray(dt, dtype))
-            return {"f": f}
+            @jax.jit
+            def run(state, steps, dt):
+                dt = jnp.asarray(dt, dtype)
+                return jax.lax.fori_loop(
+                    0, steps, lambda i, st: step(st, dt), state
+                )
 
-        self._step = step
+            return step, run
 
-        @jax.jit
-        def run(state, steps, dt):
-            dt = jnp.asarray(dt, dtype)
-            return jax.lax.fori_loop(0, steps, lambda i, st: step(st, dt), state)
+        self._step_xla, self._run_xla = make_pair(body)
+        if body_fast is not None:
+            self._step, self._run = make_pair(body_fast)
+        else:
+            self._step, self._run = self._step_xla, self._run_xla
 
-        self._run = run
+    def _disable_fused(self):
+        self._fused_block = 0
+        self._step, self._run = self._step_xla, self._run_xla
 
     # ------------------------------------------------------------ user API
 
@@ -201,9 +214,19 @@ class Vlasov:
         }
 
     def step(self, state, dt):
+        if self._fused_block:
+            return fallback_call(
+                "fused Vlasov kernel", self._step, self._step_xla,
+                self._disable_fused, state, dt,
+            )
         return self._step(state, dt)
 
     def run(self, state, steps: int, dt):
+        if self._fused_block:
+            return fallback_call(
+                "fused Vlasov kernel", self._run, self._run_xla,
+                self._disable_fused, state, steps, dt,
+            )
         return self._run(state, steps, dt)
 
     def max_time_step(self) -> float:

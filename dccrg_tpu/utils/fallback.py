@@ -1,0 +1,48 @@
+"""Shared fast-path fallback policy for optional compiled kernels.
+
+Every Pallas kernel in the models is an optimization layered over an
+always-available XLA form.  Whether the TPU compiler accepts a kernel
+can vary by hardware generation, so the first call may raise a lowering
+error — but a raise can equally be the caller's own mistake (bad state
+shape, wrong dtype).  The policy that distinguishes them: retry the
+failing call on the fallback path first.  If the fallback also raises,
+the error is the caller's and propagates unchanged; only when the
+fallback succeeds is the fast path judged broken and permanently
+disabled for the instance.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["fallback_call"]
+
+
+def fallback_call(label, fast, slow, disable, *args):
+    """``fast(*args)``, falling back to ``slow(*args)`` on error.
+
+    ``disable``: zero-arg callback run once when the fast path is judged
+    broken (fallback succeeded where it raised) — mark the instance so
+    subsequent calls skip straight to ``slow``.
+
+    Multi-controller SPMD runs re-raise instead of falling back: a
+    per-process switch would leave this controller issuing the slow
+    path's collectives while peers (whose compiler accepted the kernel)
+    run the fast path's — mismatched collective programs hang the job.
+    Failing loudly matches the pre-fallback behavior; kernel eligibility
+    gating is deterministic, so controllers only diverge on genuinely
+    heterogeneous hardware, which needs operator attention anyway."""
+    try:
+        return fast(*args)
+    except Exception as e:  # noqa: BLE001 - classified by the retry below
+        from .collectives import process_count
+
+        if process_count() > 1:
+            raise
+        try:
+            out = slow(*args)
+        except Exception:
+            raise e  # both paths fail: the input was bad, not the kernel
+        print(f"{label} disabled ({e!r:.200}); using the fallback path",
+              file=sys.stderr)
+        disable()
+        return out
