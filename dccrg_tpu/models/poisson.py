@@ -46,18 +46,25 @@ class Poisson:
     SKIP_CELL = 2
 
     def __init__(self, grid, hood_id=None, dtype=np.float64,
-                 solve_cells=None, skip_cells=None, allow_flat=True):
+                 solve_cells=None, skip_cells=None, allow_flat=True,
+                 use_pallas=True):
+        #: use_pallas follows the Advection convention: True = compiled
+        #: kernels on TPU only; "interpret" = Pallas interpreter
+        #: (CI/CPU coverage); False = XLA only
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
+        self.use_pallas = use_pallas
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
         self.tables = StencilTables(grid, hood_id, with_geometry=True)
         self._exchange = grid.halo(hood_id)
         self._full_solve = solve_cells is None
         self._build_cell_types(solve_cells, skip_cells)
         self._build_factors()
+        self._flat_tables = None
         self._flat = self._build_flat() if allow_flat else None
         self._solve = self._build_solver()
+        self._solve_fast = self._build_fast_solver()
 
     def _build_flat(self):
         """Dense flat-voxel operator (ops/flat_poisson.py) — engaged when
@@ -81,6 +88,7 @@ class Poisson:
         )
         if t is None:
             return None
+        self._flat_tables = t
         return make_flat_poisson_apply(
             t, jnp.dtype(self.dtype), mesh=self.grid.mesh
         )
@@ -343,6 +351,57 @@ class Poisson:
 
         return solve
 
+    def _build_fast_solver(self):
+        """Whole-solve fused BiCG kernel (ops/poisson_kernel.py): the
+        entire masked iteration loop in one Pallas launch with every
+        array VMEM-resident.  None when ineligible (no flat layout,
+        multi-device, f64, too large, no Pallas); the XLA solver stays
+        the fallback and the oracle (solutions agree to solver
+        tolerance — the in-kernel dot association differs)."""
+        from ..ops.dense_advection import have_pallas, pallas_available
+        from ..ops.poisson_kernel import bicg_fits, make_bicg_solve
+
+        t = self._flat_tables
+        interpret = self.use_pallas == "interpret"
+        if (
+            not self.use_pallas
+            or t is None
+            or t["n_devices"] != 1
+            or np.dtype(self.dtype) != np.float32
+            or not bicg_fits(int(np.prod(t["shape"])))
+            or not have_pallas()
+            or not (interpret or pallas_available(np.float32))
+        ):
+            return None
+        _fwd, _rev, voxelize, writeback, masks = self._flat
+        local = self.tables.local_mask
+        kern = make_bicg_solve(
+            t["shape"], t["has_coarse"], interpret=interpret
+        )
+        f32 = lambda a: jnp.asarray(np.asarray(a), jnp.float32)
+        statics = (
+            [f32(w) for pair in t["weights"] for w in pair]
+            + [f32(t["scaling"]), f32(t["fine"]), f32(~t["fine"]),
+               f32(t["orig"]), f32(t["solve"]), f32(t["dot_mask"])]
+        )
+        solve_mask = masks["solve"]
+
+        @jax.jit
+        def solve_fast(state, max_iterations, stop_residual, stop_increase):
+            rhs = jnp.where(solve_mask, voxelize(state["rhs"]), 0.0)
+            x = voxelize(state["solution"])
+            best_x, best_res, it = kern(
+                rhs.astype(jnp.float32), x.astype(jnp.float32), *statics,
+                max_iterations, stop_residual, stop_increase,
+            )
+            sol = jnp.where(local, writeback(best_x.astype(self.dtype)), 0.0)
+            return {**state, "solution": sol}, best_res[0], it[0]
+
+        return solve_fast
+
+    def _disable_fast(self):
+        self._solve_fast = None
+
     # ---------------------------------------------------------- user API
 
     def initialize_state(self, rhs_by_cell):
@@ -365,6 +424,24 @@ class Poisson:
         stop_after_residual_increase: float = 10.0,
     ):
         """Returns (state, best_residual, iterations)."""
+        if self._solve_fast is not None:
+            from ..utils.fallback import fallback_call
+
+            state, res, it = fallback_call(
+                "fused Poisson BiCG kernel",
+                lambda: self._solve_fast(
+                    state, jnp.int32(max_iterations),
+                    jnp.float32(stop_residual),
+                    jnp.float32(stop_after_residual_increase),
+                ),
+                lambda: self._solve(
+                    state, jnp.int32(max_iterations),
+                    jnp.float64(stop_residual),
+                    jnp.float64(stop_after_residual_increase),
+                ),
+                self._disable_fast,
+            )
+            return state, float(res), int(it)
         state, res, it = self._solve(
             state,
             jnp.int32(max_iterations),

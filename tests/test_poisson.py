@@ -415,3 +415,51 @@ def test_flat_path_multi_device_invariant():
     s4, i4 = solve(4)
     assert abs(i1 - i4) <= 1
     np.testing.assert_allclose(s1, s4, rtol=1e-11, atol=1e-14)
+
+
+@pytest.mark.parametrize("refine", [False, True])
+def test_fused_bicg_matches_xla_flat(refine):
+    """The whole-solve fused BiCG kernel (ops/poisson_kernel.py, interpret
+    mode) reproduces the XLA flat-path solve: same iterations, same
+    residual path, solutions equal to f32 rounding."""
+    n = 12
+    g = make_grid((n, n, n), max_ref=1 if refine else 0, n_dev=1)
+    if refine:
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        for cid in ids[np.linalg.norm(c - 0.5, axis=1) < 0.3]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+
+    fast = Poisson(g, dtype=np.float32, use_pallas="interpret")
+    slow = Poisson(g, dtype=np.float32, use_pallas=False)
+    assert fast._solve_fast is not None, "fused solve must engage"
+    assert slow._solve_fast is None
+    s0 = fast.initialize_state(rhs)
+    out_f, res_f, it_f = fast.solve(s0, max_iterations=60,
+                                    stop_residual=1e-5)
+    # the fallback policy silently swaps in the XLA solver if the kernel
+    # raises — assert the fast path actually executed, or the comparison
+    # below is XLA vs XLA
+    assert fast._solve_fast is not None, "fused solve must have run"
+    out_s, res_s, it_s = slow.solve(s0, max_iterations=60,
+                                    stop_residual=1e-5)
+    assert it_f == it_s
+    assert res_f == pytest.approx(res_s, rel=1e-5)
+    sf = np.asarray(g.get_cell_data(out_f, "solution", ids))
+    ss = np.asarray(g.get_cell_data(out_s, "solution", ids))
+    np.testing.assert_allclose(sf, ss, rtol=1e-5, atol=1e-7)
+
+
+def test_fused_bicg_gating():
+    """f64, multi-device, and no-flat grids stay off the fused solve."""
+    g = make_grid((8, 8, 8), n_dev=1)
+    assert Poisson(g)._solve_fast is None                  # f64 default
+    assert Poisson(g, dtype=np.float32,
+                   use_pallas=False)._solve_fast is None   # opt-out
+    g2 = make_grid((8, 8, 8), n_dev=4)
+    assert Poisson(g2, dtype=np.float32,
+                   use_pallas="interpret")._solve_fast is None  # multi-dev

@@ -585,6 +585,25 @@ def one(seed):
         scale = max(1.0, np.abs(sg).max())
         assert np.abs(sf - sg).max() < 1e-7 * scale, (
             seed, np.abs(sf - sg).max(), scale)
+
+    # fused whole-solve kernel (interpret) vs the f32 XLA flat path:
+    # identical masked-loop semantics -> same iteration count and
+    # solver-tolerance-equal solutions
+    pk = Poisson(g, dtype=np.float32, use_pallas='interpret', **kw)
+    if pk._solve_fast is not None:
+        px = Poisson(g, dtype=np.float32, use_pallas=False, **kw)
+        s32 = g.new_state(pk.spec)
+        s32 = g.set_cell_data(s32, 'rhs', cells,
+                              (rhs - rhs.mean()).astype(np.float32))
+        ok_, rk, itk = pk.solve(s32, max_iterations=40, stop_residual=1e-4)
+        assert pk._solve_fast is not None, (seed, 'kernel fell back')
+        ox_, rx, itx = px.solve(s32, max_iterations=40, stop_residual=1e-4)
+        assert abs(itk - itx) <= 1, (seed, itk, itx)
+        sk = np.asarray(g.get_cell_data(ok_, 'solution', cells))
+        sx = np.asarray(g.get_cell_data(ox_, 'solution', cells))
+        scale = max(1.0, np.abs(sx).max())
+        assert np.abs(sk - sx).max() < 1e-4 * scale, (
+            seed, np.abs(sk - sx).max(), scale)
     return 'flat-ok', n_dev, mode
 
 for seed in range(int(sys.argv[1]), int(sys.argv[2])):
