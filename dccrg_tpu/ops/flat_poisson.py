@@ -155,13 +155,13 @@ def make_flat_poisson_apply(tables, dtype, mesh=None):
     D = tables["n_devices"]
     shape = tables["shape"]
     if D > 1:
-        from ..parallel.mesh import SHARD_AXIS
-        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+        # the Tables seam (parallel/mesh.put_table): sharded device
+        # arrays under one controller; host numpy under many — jit
+        # embeds replicated constants freely, while closing over a
+        # device array spanning other processes' devices is rejected
+        from ..parallel.mesh import put_table
 
-        vox_sharding = NamedSharding(mesh, Pspec(SHARD_AXIS, None, None))
-        put = lambda a, dt=None: jax.device_put(
-            jnp.asarray(a, dt), vox_sharding
-        )
+        put = lambda a, dt=None: put_table(a, mesh, dtype=dt)
     else:
         put = lambda a, dt=None: jnp.asarray(a, dt)
     fine_f = put(tables["fine"], dtype)
@@ -241,9 +241,9 @@ def make_flat_poisson_apply(tables, dtype, mesh=None):
 
         nzv, nyv, nxv = shape
         slab = nzv // D
-        rows_d = jnp.asarray(tables["rows"])        # [D, n_loc]
-        wb_rows = jnp.asarray(tables["wb_rows"])    # [D, R]
-        wb_valid = jnp.asarray(tables["wb_valid"])
+        rows_d = put_table(tables["rows"], mesh)        # [D, n_loc]
+        wb_rows = put_table(tables["wb_rows"], mesh)    # [D, R]
+        wb_valid = put_table(tables["wb_valid"], mesh)
 
         def _lift(row_arr, rmap):
             return row_arr[0][rmap[0]].reshape(slab, nyv, nxv).astype(dtype)

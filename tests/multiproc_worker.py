@@ -159,6 +159,45 @@ def main() -> None:
     if pid == 0:
         os.unlink(ckpt)
 
+    # ---- scenario 6: flat sharded Poisson solve across controllers --
+    # the gather-free voxel BiCG (ops/flat_poisson.py) with the voxel
+    # arrays z-slab sharded over the PROCESS-SPANNING mesh: the matvec's
+    # z-rolls become collective permutes over the wire and the BiCG dots
+    # reduce across controllers.
+    from dccrg_tpu import CartesianGeometry
+    from dccrg_tpu.models import Poisson
+
+    D = dpp * nproc
+    n = D  # grid edge = device count: z-slabs divide evenly
+    gp = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    cells = np.sort(gp.leaves.cells)
+    cen = gp.geometry.get_center(cells)
+    rhs = np.sin(2 * np.pi * cen[:, 0]) * np.cos(2 * np.pi * cen[:, 1])
+    pp = Poisson(gp)
+    assert pp._flat is not None, "flat sharded path must engage"
+    assert pp._flat_tables["n_devices"] == D
+    sp = pp.initialize_state(rhs)
+    op, rp, itp = pp.solve(sp, max_iterations=25, stop_residual=0.0,
+                           stop_after_residual_increase=float("inf"))
+    sol = np.asarray(gp.get_cell_data(op, "solution", cells), np.float64)
+    res["poisson_flat"] = {
+        "n_devices": D,
+        "iterations": int(itp),
+        "residual": float(rp),
+        "solution": [float(v) for v in sol],
+    }
+
     print("RESULT " + json.dumps(res), flush=True)
 
 

@@ -146,3 +146,43 @@ def test_pins_honored_across_controllers(multi_proc_results):
     assert res["pins"]["first_owner"] == res["n_devices"] - 1
     assert res["pins"]["last_owner"] == 0
     assert res["ghost"] == "ok"
+
+
+def test_flat_poisson_across_controllers(multi_proc_results):
+    """The gather-free flat Poisson solve over the process-spanning mesh
+    (z-roll collective permutes + cross-controller BiCG dots) must equal
+    a single-process run on an identically-sized mesh."""
+    res = multi_proc_results[0]["poisson_flat"]
+    D = res["n_devices"]
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Poisson
+
+    n = D  # grid edge = device count: z-slabs divide evenly
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=D))
+    )
+    cells = np.sort(g.leaves.cells)
+    cen = g.geometry.get_center(cells)
+    rhs = np.sin(2 * np.pi * cen[:, 0]) * np.cos(2 * np.pi * cen[:, 1])
+    p = Poisson(g)
+    assert p._flat is not None
+    s = p.initialize_state(rhs)
+    out, r, it = p.solve(s, max_iterations=25, stop_residual=0.0,
+                         stop_after_residual_increase=float("inf"))
+    assert res["iterations"] == it
+    sol = np.asarray(g.get_cell_data(out, "solution", cells), np.float64)
+    # gloo cross-process dots vs XLA in-process dots may round
+    # differently; 25 BiCG iterations compound it — loose but meaningful
+    np.testing.assert_allclose(np.asarray(res["solution"]), sol,
+                               rtol=1e-7, atol=1e-10)
+    assert res["residual"] == pytest.approx(r, rel=1e-6)
