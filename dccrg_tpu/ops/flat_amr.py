@@ -512,7 +512,7 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.dense import HaloExtend
-    from ..parallel.mesh import SHARD_AXIS, shard_spec
+    from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
 
     nzl1, ny1, nx1 = tables["shape"]
     D = tables["n_devices"]
@@ -618,8 +618,11 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
         check_vma=False,
     )
 
-    put = lambda a: jax.device_put(jnp.asarray(a), shard_spec(mesh, np.ndim(a)))
-    statics = tuple(put(tables[k]) for k in
+    # the Tables seam (parallel/mesh.put_table): sharded device arrays
+    # under one controller, host numpy under many — run_fn's jit closes
+    # over these, and closing over arrays spanning other processes'
+    # devices is rejected by JAX
+    statics = tuple(put_table(tables[k], mesh) for k in
                     ("rows", "leaf_fine", "leaf_ext", "wb_rows", "wb_valid"))
 
     @jax.jit

@@ -241,9 +241,9 @@ def make_flat_poisson_apply(tables, dtype, mesh=None):
 
         nzv, nyv, nxv = shape
         slab = nzv // D
-        rows_d = put_table(tables["rows"], mesh)        # [D, n_loc]
-        wb_rows = put_table(tables["wb_rows"], mesh)    # [D, R]
-        wb_valid = put_table(tables["wb_valid"], mesh)
+        rows_d = put(tables["rows"])        # [D, n_loc]
+        wb_rows = put(tables["wb_rows"])    # [D, R]
+        wb_valid = put(tables["wb_valid"])
 
         def _lift(row_arr, rmap):
             return row_arr[0][rmap[0]].reshape(slab, nyv, nxv).astype(dtype)

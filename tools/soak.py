@@ -569,13 +569,28 @@ def one(seed):
 
     s0 = g.new_state(pf.spec)
     s0 = g.set_cell_data(s0, 'rhs', cells, rhs - rhs.mean())
-    of, rf, itf = pf.solve(s0, max_iterations=60, stop_residual=1e-11)
-    og, rg, itg = pg.solve(s0, max_iterations=60, stop_residual=1e-11)
+    rhs_norm = float(np.linalg.norm(rhs))
+
+    def restarted(p):
+        # the reference's usage shape: BiCG on these non-normal systems
+        # (random roles + AMR) can break down mid-Krylov-space — drivers
+        # re-invoke solve from the best solution (a restart), which
+        # rebuilds the space and recovers (seed 529: 1.4e-5 -> 6.5e-12
+        # in 3 restarts).  Compare the PATHS under the same driver, not
+        # single trajectories, which legitimately diverge in rounding.
+        st, _r, _i = p.solve(s0, max_iterations=60, stop_residual=1e-11)
+        for _ in range(4):
+            if pg.residual(st) <= 1e-10 * rhs_norm:
+                break
+            st, _r, _i = p.solve(st, max_iterations=60, stop_residual=1e-11)
+        return st
+
+    of = restarted(pf)
+    og = restarted(pg)
     # solution quality under the GATHER operator (the oracle): the flat
     # solve must be as good as the gather solve up to a modest factor
     rf_chk = pg.residual(of)
     rg_chk = pg.residual(og)
-    rhs_norm = float(np.linalg.norm(rhs))
     assert rf_chk <= 10.0 * rg_chk + 1e-9 * rhs_norm, (
         seed, rf_chk, rg_chk)
     if max(rf_chk, rg_chk) < 1e-10 * rhs_norm:
