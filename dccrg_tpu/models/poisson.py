@@ -422,8 +422,31 @@ class Poisson:
         max_iterations: int = 1000,
         stop_residual: float = 1e-12,
         stop_after_residual_increase: float = 10.0,
+        restarts: int = 0,
     ):
-        """Returns (state, best_residual, iterations)."""
+        """Returns (state, best_residual, iterations).
+
+        ``restarts``: BiCG on non-normal systems (AMR + mixed cell
+        roles) can break down mid-Krylov-space and stop at the
+        semi-convergence rule far from the target; re-invoking from the
+        best solution rebuilds the space and recovers (the reference's
+        drivers re-invoke solve for exactly this).  With ``restarts=N``
+        the solve re-enters up to N more times until ``stop_residual``
+        is met or an attempt makes no progress; iterations accumulate.
+        Default 0 = the reference's single-trajectory behavior."""
+        if restarts > 0:
+            total_it = 0
+            prev_res = float("inf")
+            for _ in range(restarts + 1):
+                state, res, it = self.solve(
+                    state, max_iterations, stop_residual,
+                    stop_after_residual_increase,
+                )
+                total_it += it
+                if res <= stop_residual or not res < prev_res:
+                    break  # converged, or the attempt made no progress
+                prev_res = res
+            return state, res, total_it
         if self._solve_fast is not None:
             from ..utils.fallback import fallback_call
 
