@@ -47,8 +47,11 @@ def main():
 
     model = Poisson(grid)
     state = model.initialize_state(rhs)
+    # restarts: BiCG on refined (non-normal) systems can stop early at
+    # the semi-convergence rule; re-entering from the best solution
+    # recovers (see Poisson.solve)
     state, residual, iterations = model.solve(
-        state, max_iterations=2000, stop_residual=1e-10
+        state, max_iterations=2000, stop_residual=1e-10, restarts=3
     )
 
     phi = np.asarray(grid.get_cell_data(state, "solution", ids), np.float64)
