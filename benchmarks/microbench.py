@@ -122,6 +122,34 @@ def bench_refinement(length: int):
         "detail": {"requested": len(leaves), "removed": len(removed), "secs": round(secs, 3)},
     }))
 
+    # the same storms through the vectorized bulk request APIs
+    # (identical queue semantics; what adaptation drivers use)
+    cells = g.get_cells()
+    t0 = time.perf_counter()
+    g.refine_completely_many(cells)
+    created = g.stop_refining()
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bulk_refinement_cells_created_per_sec",
+        "value": round(len(created) / secs, 1),
+        "unit": "cells/s",
+        "detail": {"requested": len(cells), "created": len(created),
+                   "secs": round(secs, 3)},
+    }))
+    leaves = g.get_cells()
+    t0 = time.perf_counter()
+    g.unrefine_completely_many(leaves)
+    g.stop_refining()
+    removed = g.get_removed_cells()
+    secs = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bulk_unrefinement_cells_removed_per_sec",
+        "value": round(len(removed) / secs, 1),
+        "unit": "cells/s",
+        "detail": {"requested": len(leaves), "removed": len(removed),
+                   "secs": round(secs, 3)},
+    }))
+
 
 def bench_checkpoint(length: int):
     """Million-cell checkpoint round trip (reference save_grid_data /

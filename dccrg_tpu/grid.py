@@ -822,53 +822,62 @@ class Grid:
         self.amr.to_unrefine.add(cell)
         return True
 
+    def _build_unrefine_cache(self):
+        """Per-epoch vectorized answers for the unrefine parent-hood
+        checks: ONE neighbor search over every candidate parent (the
+        per-family scalar search used to dominate unrefinement request
+        storms).  Returns ``(epoch, parents(sorted), too_fine_all,
+        fcells, fstart)`` — per-parent answers resolve lazily by
+        searchsorted; shared by the scalar and bulk request paths."""
+        cache = getattr(self, "_unrefine_cache", None)
+        if cache is not None and cache[0] is self.epoch:
+            return cache
+        from .amr.refinement import _find_for_nonleaves
+
+        lvl = self.mapping.get_refinement_level(self.leaves.cells)
+        finer = self.leaves.cells[lvl > 0]
+        parents = np.unique(self.mapping.get_parent(finer))
+        if len(parents):
+            plists = _find_for_nonleaves(
+                self.mapping, self.topology, self.leaves,
+                parents, self.neighborhoods[None],
+            )
+            p_lvl = self.mapping.get_refinement_level(parents)
+            counts = np.diff(plists.start)
+            src = np.repeat(np.arange(len(parents)), counts)
+            pos = plists.nbr_pos
+            neg = (pos < 0).astype(np.int64)
+            cum = np.concatenate(([0], np.cumsum(neg)))
+            too_fine_all = (
+                cum[plists.start[1:]] - cum[plists.start[:-1]]
+            ) > 0
+            n_lvl = np.where(
+                pos >= 0,
+                self.mapping.get_refinement_level(
+                    self.leaves.cells[np.maximum(pos, 0)]
+                ),
+                -1,
+            )
+            fine_mask = n_lvl == p_lvl[src] + 1
+            fsrc = src[fine_mask]
+            fcells = self.leaves.cells[pos[fine_mask]]
+            fcounts = np.bincount(fsrc, minlength=len(parents))
+            fstart = np.concatenate(([0], np.cumsum(fcounts)))
+        else:
+            too_fine_all = np.zeros(0, dtype=bool)
+            fcells = np.zeros(0, dtype=np.uint64)
+            fstart = np.zeros(1, dtype=np.int64)
+        cache = (self.epoch, parents, too_fine_all, fcells, fstart)
+        self._unrefine_cache = cache
+        return cache
+
     def _unrefine_parent_info(self, parent: int):
         """(too_fine, ids of the parent's would-be neighbors one level
-        finer than it) for a candidate parent.  Built per epoch with ONE
-        vectorized neighbor search over every candidate parent (the
-        per-family scalar search used to dominate unrefinement request
-        storms); the per-parent answer resolves lazily by searchsorted,
-        so no per-parent Python structures are materialized."""
-        cache = getattr(self, "_unrefine_cache", None)
-        if cache is None or cache[0] is not self.epoch:
-            from .amr.refinement import _find_for_nonleaves
-
-            lvl = self.mapping.get_refinement_level(self.leaves.cells)
-            finer = self.leaves.cells[lvl > 0]
-            parents = np.unique(self.mapping.get_parent(finer))
-            if len(parents):
-                plists = _find_for_nonleaves(
-                    self.mapping, self.topology, self.leaves,
-                    parents, self.neighborhoods[None],
-                )
-                p_lvl = self.mapping.get_refinement_level(parents)
-                counts = np.diff(plists.start)
-                src = np.repeat(np.arange(len(parents)), counts)
-                pos = plists.nbr_pos
-                neg = (pos < 0).astype(np.int64)
-                cum = np.concatenate(([0], np.cumsum(neg)))
-                too_fine_all = (
-                    cum[plists.start[1:]] - cum[plists.start[:-1]]
-                ) > 0
-                n_lvl = np.where(
-                    pos >= 0,
-                    self.mapping.get_refinement_level(
-                        self.leaves.cells[np.maximum(pos, 0)]
-                    ),
-                    -1,
-                )
-                fine_mask = n_lvl == p_lvl[src] + 1
-                fsrc = src[fine_mask]
-                fcells = self.leaves.cells[pos[fine_mask]]
-                fcounts = np.bincount(fsrc, minlength=len(parents))
-                fstart = np.concatenate(([0], np.cumsum(fcounts)))
-            else:
-                too_fine_all = np.zeros(0, dtype=bool)
-                fcells = np.zeros(0, dtype=np.uint64)
-                fstart = np.zeros(1, dtype=np.int64)
-            cache = (self.epoch, parents, too_fine_all, fcells, fstart)
-            self._unrefine_cache = cache
-        _, parents, too_fine_all, fcells, fstart = cache
+        finer than it) for a candidate parent, from the per-epoch
+        cache."""
+        _, parents, too_fine_all, fcells, fstart = (
+            self._build_unrefine_cache()
+        )
         i = int(np.searchsorted(parents, np.uint64(parent)))
         if i >= len(parents) or parents[i] != np.uint64(parent):
             return True, frozenset()
@@ -902,6 +911,174 @@ class Grid:
             self.amr.to_unrefine.discard(s)
         self.amr.not_to_unrefine.add(cell)
         return True
+
+    # ------------------------------------------------- bulk request storms
+
+    def _set_array(self, s):
+        return np.fromiter(s, dtype=np.uint64, count=len(s))
+
+    def refine_completely_many(self, cells) -> np.ndarray:
+        """Vectorized ``refine_completely`` over an id array: identical
+        final queue state and per-cell returns to calling the scalar API
+        in order.  The vectorized form engages when no unrefines are
+        pending and no refine vetoes exist (the mass-storm shape of
+        adaptation drivers, where the scalar loop's per-request checks
+        all degenerate); otherwise it falls back to the scalar loop."""
+        ids = np.asarray(cells, dtype=np.uint64).reshape(-1)
+        if len(ids) == 0:
+            return np.zeros(0, dtype=bool)
+        if self.amr.not_to_refine or self.amr.to_unrefine:
+            return np.array(
+                [self.refine_completely(int(c)) for c in ids], dtype=bool
+            )
+        pos = self.leaves.position(ids)
+        exists = pos >= 0
+        lvl = self.mapping.get_refinement_level(ids)
+        at_max = exists & (lvl == self.mapping.max_refinement_level)
+        if at_max.any():
+            self.dont_unrefine_many(ids[at_max])
+        mid = exists & ~at_max
+        self.amr.to_refine.update(int(c) for c in ids[mid])
+        return exists
+
+    def unrefine_completely_many(self, cells) -> np.ndarray:
+        """Vectorized ``unrefine_completely`` over an id array: identical
+        final queue state and returns to the scalar loop (a pure
+        unrefine storm's queue interactions are family-local, so every
+        check vectorizes: sibling leaf-ness, refine-queued/vetoed
+        siblings, already-queued families, the cached parent-hood
+        answers, and first-requested-sibling-per-family dedupe)."""
+        ids = np.asarray(cells, dtype=np.uint64).reshape(-1)
+        out = np.zeros(len(ids), dtype=bool)
+        if len(ids) == 0:
+            return out
+        pos = self.leaves.position(ids)
+        exists = pos >= 0
+        lvl = np.where(exists, self.mapping.get_refinement_level(ids), 0)
+        out[exists & (lvl == 0)] = True
+        idx = np.flatnonzero(exists & (lvl > 0))
+        if not len(idx):
+            return out
+        sibs = self.mapping.get_siblings(ids[idx]).reshape(len(idx), 8)
+        sib_leaf = self.leaves.exists(sibs.reshape(-1)).reshape(-1, 8)
+        # one to_refine conversion per storm, shared with the parent-hood
+        # check below
+        tr_arr = (self._set_array(self.amr.to_refine)
+                  if self.amr.to_refine else None)
+        # the scalar loop walks siblings IN ORDER: the first non-leaf
+        # sibling returns False, but a refine-queued/vetoed sibling
+        # EARLIER in the family returns True first
+        queued = np.zeros_like(sib_leaf)
+        if tr_arr is not None:
+            queued |= np.isin(sibs, tr_arr)
+        if self.amr.not_to_unrefine:
+            queued |= np.isin(
+                sibs, self._set_array(self.amr.not_to_unrefine)
+            )
+        nonleaf = ~sib_leaf
+        first_nonleaf = np.where(
+            nonleaf.any(axis=1), np.argmax(nonleaf, axis=1), 8
+        )
+        first_queued = np.where(
+            queued.any(axis=1), np.argmax(queued, axis=1), 8
+        )
+        # (a queued sibling strictly earlier than the first non-leaf one
+        # wins the True return)
+        ret_false = (first_nonleaf < 8) & ~(first_queued < first_nonleaf)
+        out[idx] = ~ret_false
+        proceed = (first_nonleaf == 8) & (first_queued == 8)
+        idx = idx[proceed]
+        if not len(idx):
+            return out
+        parents = self.mapping.get_parent(ids[idx])
+        # family already queued before this storm
+        if self.amr.to_unrefine:
+            tu = self._set_array(self.amr.to_unrefine)
+            queued_parents = np.unique(self.mapping.get_parent(tu))
+            fresh = ~np.isin(parents, queued_parents)
+            idx, parents = idx[fresh], parents[fresh]
+            if not len(idx):
+                return out
+        # the parent's would-be neighborhood (per-epoch vectorized cache)
+        too_fine, has_refining = self._unrefine_parent_info_many(
+            parents, tr_arr
+        )
+        qual = ~too_fine & ~has_refining
+        idx, parents = idx[qual], parents[qual]
+        if len(idx):
+            # first-requested sibling per family wins (np.unique's
+            # return_index is the first occurrence in input order)
+            _u, first = np.unique(parents, return_index=True)
+            self.amr.to_unrefine.update(
+                int(c) for c in ids[idx[np.sort(first)]]
+            )
+        return out
+
+    def dont_unrefine_many(self, cells) -> np.ndarray:
+        """Vectorized ``dont_unrefine``; engages when no unrefines are
+        pending (nothing to discard), else scalar fallback."""
+        ids = np.asarray(cells, dtype=np.uint64).reshape(-1)
+        if len(ids) == 0:
+            return np.zeros(0, dtype=bool)
+        if self.amr.to_unrefine:
+            return np.array(
+                [self.dont_unrefine(int(c)) for c in ids], dtype=bool
+            )
+        pos = self.leaves.position(ids)
+        exists = pos >= 0
+        lvl = np.where(exists, self.mapping.get_refinement_level(ids), 0)
+        idx = np.flatnonzero(exists & (lvl > 0))
+        if len(idx):
+            parents = self.mapping.get_parent(ids[idx])
+            if self.amr.not_to_unrefine:
+                ntu = self._set_array(self.amr.not_to_unrefine)
+                vetoed_parents = np.unique(self.mapping.get_parent(ntu))
+                fresh = ~np.isin(parents, vetoed_parents)
+                idx, parents = idx[fresh], parents[fresh]
+            if len(idx):
+                _u, first = np.unique(parents, return_index=True)
+                self.amr.not_to_unrefine.update(
+                    int(c) for c in ids[idx[np.sort(first)]]
+                )
+        return exists
+
+    def dont_refine_many(self, cells) -> np.ndarray:
+        """Vectorized ``dont_refine`` (always exact: discard + add)."""
+        ids = np.asarray(cells, dtype=np.uint64).reshape(-1)
+        if len(ids) == 0:
+            return np.zeros(0, dtype=bool)
+        pos = self.leaves.position(ids)
+        exists = pos >= 0
+        lvl = self.mapping.get_refinement_level(ids)
+        mid = exists & (lvl < self.mapping.max_refinement_level)
+        mids = [int(c) for c in ids[mid]]
+        self.amr.to_refine.difference_update(mids)
+        self.amr.not_to_refine.update(mids)
+        return exists
+
+    def _unrefine_parent_info_many(self, parents, tr_arr=None):
+        """Vectorized ``_unrefine_parent_info`` over a parent array:
+        (too_fine, same-level-neighbor-being-refined) per parent from
+        the per-epoch cache.  ``tr_arr``: the caller's to_refine array
+        (one conversion per storm)."""
+        _, cp, too_fine_all, fcells, fstart = self._build_unrefine_cache()
+        i = np.searchsorted(cp, parents)
+        ic = np.minimum(i, max(len(cp) - 1, 0))
+        found = (i < len(cp)) & (len(cp) > 0)
+        if len(cp):
+            found &= cp[ic] == parents
+        too_fine = np.where(found, too_fine_all[ic] if len(cp) else True,
+                            True)
+        if tr_arr is None and self.amr.to_refine:
+            tr_arr = self._set_array(self.amr.to_refine)
+        if tr_arr is not None and len(tr_arr) and len(fcells):
+            hit = np.isin(fcells, tr_arr).astype(np.int64)
+            csum = np.concatenate(([0], np.cumsum(hit)))
+            seg = (csum[fstart[1:]] - csum[fstart[:-1]]) > 0
+            has_ref = np.where(found, seg[ic] if len(cp) else False, False)
+        else:
+            has_ref = np.zeros(len(parents), dtype=bool)
+        return too_fine, has_ref
 
     def refine_completely_at(self, coords) -> bool:
         c = self._cell_at(coords)

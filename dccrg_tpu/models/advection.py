@@ -802,13 +802,12 @@ class Advection:
         lvl = grid.mapping.get_refinement_level(cells)
         refine_diff = (lvl + 1) * diff_increase
         unrefine_diff = unrefine_sensitivity * refine_diff
-        for c in cells[md > refine_diff]:
-            grid.refine_completely(int(c))
+        # bulk request storms (grid.py: identical queue state to the
+        # scalar per-cell calls, vectorized)
+        grid.refine_completely_many(cells[md > refine_diff])
         hold = (md <= refine_diff) & (md >= unrefine_diff)
-        for c in cells[hold & (lvl > 0)]:
-            grid.dont_unrefine(int(c))
-        for c in cells[(md < unrefine_diff) & (lvl > 0)]:
-            grid.unrefine_completely(int(c))
+        grid.dont_unrefine_many(cells[hold & (lvl > 0)])
+        grid.unrefine_completely_many(cells[(md < unrefine_diff) & (lvl > 0)])
         return state
 
     def adapt_grid(self, state):
