@@ -292,8 +292,7 @@ class GridLoader:
                     anc_lvl > lvl, mapping.get_parent(ancestors), ancestors
                 )
                 anc_lvl = mapping.get_refinement_level(ancestors)
-            for c in np.unique(ancestors):
-                grid.refine_completely(int(c))
+            grid.refine_completely_many(np.unique(ancestors))
             grid.stop_refining()
 
         if not np.array_equal(np.sort(saved), grid.get_cells()):
