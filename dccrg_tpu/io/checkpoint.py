@@ -280,23 +280,14 @@ class GridLoader:
         grid._geometry_factory = lambda m, t: geom_cls.params_from_file_bytes(
             geometry.params_to_file_bytes(), m, t
         )[0]
-        grid.initialize(mesh=mesh, n_devices=n_devices)
-
+        # direct leaf-set construction: the saved set is a valid 2:1
+        # forest, so derived state builds ONCE (initialize validates
+        # tiling + 2:1 and raises on a corrupt file) — the TPU-native
+        # replacement for the reference's level-by-level refinement
+        # replay (dccrg.hpp:3647-3716), which costs one full rebuild per
+        # refinement level
         saved = self.saved_cells
-        lvls = mapping.get_refinement_level(saved)
-        for lvl in range(int(lvls.max()) if len(lvls) else 0):
-            ancestors = saved[lvls > lvl]
-            anc_lvl = mapping.get_refinement_level(ancestors)
-            while (anc_lvl > lvl).any():
-                ancestors = np.where(
-                    anc_lvl > lvl, mapping.get_parent(ancestors), ancestors
-                )
-                anc_lvl = mapping.get_refinement_level(ancestors)
-            grid.refine_completely_many(np.unique(ancestors))
-            grid.stop_refining()
-
-        if not np.array_equal(np.sort(saved), grid.get_cells()):
-            raise RuntimeError("refinement replay did not reproduce the saved grid")
+        grid.initialize(mesh=mesh, n_devices=n_devices, leaf_set=saved)
         grid.balance_load()
         self.grid = grid
 

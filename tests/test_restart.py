@@ -232,3 +232,41 @@ def test_fuzz_checkpoint_roundtrip_random_grids(seed):
         np.asarray(adv2.get_cell_data(b, "density", ids)),
         rtol=1e-13, atol=0,
     )
+
+
+def test_leaf_set_initialize_validates():
+    """Direct leaf-set construction (the loader's path) rejects corrupt
+    sets: duplicates, holes, and 2:1 violations all raise."""
+    from dccrg_tpu import Grid, make_mesh
+
+    def fresh():
+        return (
+            Grid()
+            .set_initial_length((4, 4, 4))
+            .set_maximum_refinement_level(2)
+            .set_neighborhood_length(1)
+        )
+
+    base = np.arange(1, 65, dtype=np.uint64)
+
+    # valid: one cell refined one level
+    g0 = fresh().initialize(mesh=make_mesh(n_devices=1))
+    kids = g0.mapping.get_all_children(np.uint64(1))
+    ok = np.concatenate([base[1:], kids]).astype(np.uint64)
+    g = fresh().initialize(mesh=make_mesh(n_devices=1), leaf_set=ok)
+    assert len(g.get_cells()) == 63 + 8
+
+    with pytest.raises(ValueError, match="duplicate"):
+        fresh().initialize(
+            mesh=make_mesh(n_devices=1),
+            leaf_set=np.concatenate([base, base[:1]]),
+        )
+    with pytest.raises(ValueError, match="tile"):
+        fresh().initialize(mesh=make_mesh(n_devices=1), leaf_set=base[1:])
+    # 2:1 violation: a level-2 family island inside level-0 neighbors
+    grandkids = np.concatenate(
+        [g0.mapping.get_all_children(k) for k in kids]
+    ).astype(np.uint64)
+    bad = np.concatenate([base[1:], grandkids])
+    with pytest.raises(ValueError, match="2:1|consistent"):
+        fresh().initialize(mesh=make_mesh(n_devices=1), leaf_set=bad)
