@@ -748,7 +748,10 @@ def _emit_fallback(diag):
                 "refined_note": "boxed per-level path (the cost heuristic "
                                 "now picks it over the flat kernel at "
                                 "this inflation; flat measured 1.34e9 "
-                                "after its VMEM fix)",
+                                "after its VMEM fix; the lane-padded "
+                                "flat kernel landed during the outage — "
+                                "the dispatch edge constant recalibrates "
+                                "when the onchip battery's sweep runs)",
                 "large_streaming_updates_per_s": 1.600e10,
                 "large_vs_baseline": 244.5,
                 "large_hbm_fraction_of_peak": 0.391,
@@ -757,8 +760,10 @@ def _emit_fallback(diag):
                                 "landed after the outage began and has "
                                 "no on-chip number yet",
                 "vlasov_phase_updates_per_s": 6.10e9,
-                "note": "fused-GoL and device-side PIC measurements also "
-                        "await the tunnel",
+                "note": "fused-GoL, device-side PIC, fused-Vlasov, and "
+                        "whole-solve-Poisson kernel measurements await "
+                        "the tunnel (tools/onchip_r3.py --watch measures "
+                        "incrementally whenever it comes up)",
             },
             "onchip_battery": battery,
             "multidev_cpu": r8,
