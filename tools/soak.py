@@ -10,7 +10,9 @@ discipline applied as fuzzing — run it after substantial changes:
 Subsystems: paths (boxed/flat advection vs general), three_level,
 amr (commit pipeline + verify + mass), checkpoint (round trips across
 device counts), particles, gol (all four variants), hoods (user
-neighborhoods), vlasov (conservation).
+neighborhoods), vlasov (conservation + fused-kernel bit-identity),
+poisson (flat/gather solve differential under the restart driver +
+fused whole-solve kernel).
 """
 import argparse
 import pathlib
