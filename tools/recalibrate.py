@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Turn the on-chip battery's measurements into dispatch recalibrations.
+
+Run after ``tools/onchip_r3.py`` has produced ``tools/onchip_r3.json``:
+
+    python tools/recalibrate.py
+
+Prints the measured flat-kernel per-voxel rates (padded vs unpadded),
+the boxed path's per-voxel rate inferred from the refined dispatch
+measurement, and the recommended flat/boxed edge constant for
+``models/advection.py`` (``_prefer_boxed``: prefer boxed when
+``flat_n_vox > EDGE * boxed_vol``).  The constant is the measured ratio
+of the flat kernel's voxel-update rate to the boxed path's — with a
+0.8 safety factor so the dispatch only flips when the win is clear.
+"""
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+BATTERY = ROOT / "tools" / "onchip_r3.json"
+
+#: the refined bench grid's dispatch inputs (48^3 coarse, ball refined;
+#: computed from the grid build — see the session notes)
+REFINED_N_CELLS = 198008
+REFINED_BOXED_VOL = 292480
+REFINED_FLAT_VOX = 884736
+
+
+def main():
+    if not BATTERY.exists():
+        sys.exit("no tools/onchip_r3.json yet — run tools/onchip_r3.py "
+                 "when the TPU tunnel is up")
+    data = json.loads(BATTERY.read_text())
+
+    sweep = data.get("flat_kernel_sweep_Bvox_per_s") or {}
+    flat_unpadded = sweep.get("96x96x96")
+    flat_padded = sweep.get("96x96x96x128")
+    print("flat kernel sweep (B voxel-updates/s):")
+    for k, v in sweep.items():
+        print(f"  {k}: {v}")
+    if isinstance(flat_padded, (int, float)) and \
+            isinstance(flat_unpadded, (int, float)) and flat_unpadded:
+        print(f"  lane-padding speedup on the refined-bench shape: "
+              f"{flat_padded / flat_unpadded:.2f}x")
+
+    ref = data.get("refined_dispatch") or {}
+    rate = ref.get("updates_per_s")
+    if rate:
+        n_cells = ref.get("n_cells", REFINED_N_CELLS)
+        if n_cells != REFINED_N_CELLS:
+            print(f"\nWARNING: measured n_cells {n_cells} != the hardcoded "
+                  f"dispatch inputs ({REFINED_N_CELLS}) — the boxed volume "
+                  f"and voxel ratio below are stale; recompute them for "
+                  f"the current bench config")
+        steps_per_s = rate / n_cells
+        print(f"\nrefined dispatch: {rate:.3e} cell-updates/s "
+              f"({steps_per_s:.0f} steps/s)")
+        # whichever path the dispatch picked retires its voxel volume
+        # at steps_per_s; infer the boxed per-voxel rate from it when
+        # boxed was picked (the current default at edge 2.0)
+        boxed_vox_rate = steps_per_s * REFINED_BOXED_VOL / 1e9
+        print(f"  implied boxed per-voxel rate (if boxed ran): "
+              f"{boxed_vox_rate:.2f} B voxel-updates/s")
+        if isinstance(flat_padded, (int, float)):
+            edge = flat_padded / boxed_vox_rate
+            rec = round(0.8 * edge, 1)
+            print(f"\npadded-flat / boxed per-voxel edge: {edge:.2f}")
+            print(f"recommended _prefer_boxed constant "
+                  f"(models/advection.py, currently 2.0): {rec}")
+            ratio = REFINED_FLAT_VOX / REFINED_BOXED_VOL
+            print(f"refined-bench voxel ratio is {ratio:.2f} -> dispatch "
+                  f"{'FLIPS to flat' if rec > ratio else 'stays boxed'} "
+                  f"on that config with that constant")
+    else:
+        print("\nno refined_dispatch measurement yet")
+
+
+if __name__ == "__main__":
+    main()
