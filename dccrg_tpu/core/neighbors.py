@@ -32,12 +32,20 @@ from .mapping import Mapping
 from .topology import Topology
 
 __all__ = [
+    "InconsistentGridError",
     "LeafSet",
     "NeighborLists",
     "find_all_neighbors",
     "invert_neighbors",
     "face_directions",
 ]
+
+
+class InconsistentGridError(RuntimeError):
+    """A leaf set that violates the tiling/2:1 invariants the neighbor
+    engine assumes (a slot inside the grid covered by no leaf of level
+    l-1/l/l+1).  Callers validating untrusted leaf sets (checkpoint
+    reload) catch this type rather than matching message text."""
 
 
 def face_directions(off, clen, nlen):
@@ -188,7 +196,7 @@ def find_all_neighbors(
         unresolved = valid & ~has_same & ~has_coarse & ~has_finer
         if unresolved.any():
             i, k = np.argwhere(unresolved)[0]
-            raise RuntimeError(
+            raise InconsistentGridError(
                 f"inconsistent grid: no neighbor leaf for cell {src_cells[i]} "
                 f"slot {tuple(hood[k])}"
             )
@@ -250,7 +258,9 @@ def find_all_neighbors(
     nbr_pos = leaves.position(nbr_cell)
     if strict and (nbr_pos < 0).any():
         bad = nbr_cell[nbr_pos < 0][0]
-        raise RuntimeError(f"neighbor {bad} is not an existing leaf (2:1 violation?)")
+        raise InconsistentGridError(
+            f"neighbor {bad} is not an existing leaf (2:1 violation?)"
+        )
 
     row_counts = counts.sum(axis=1)
     start = np.zeros(N + 1, dtype=np.int64)

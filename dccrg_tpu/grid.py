@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from .core.mapping import Mapping
 from .core.topology import Topology
 from .core.neighborhood import default_neighborhood, validate_neighborhood
-from .core.neighbors import LeafSet
+from .core.neighbors import InconsistentGridError, LeafSet
 from .geometry import CartesianGeometry, NoGeometry
 from .parallel.epoch import build_epoch
 from .parallel.halo import HaloExchange
@@ -158,10 +158,7 @@ class Grid:
             # contract as the explicit checks
             try:
                 self._rebuild()
-            except RuntimeError as e:
-                if "no neighbor leaf" not in str(e) and \
-                        "inconsistent" not in str(e):
-                    raise  # an internal failure, not a bad leaf set
+            except InconsistentGridError as e:
                 raise ValueError(
                     f"leaf_set is not a consistent 2:1 forest: {e}"
                 ) from e
@@ -172,9 +169,11 @@ class Grid:
 
     def _validate_leaf_tiling(self, cells):
         """Exact-cover check for a candidate leaf set: the level-weighted
-        volumes must tile the domain exactly (integer arithmetic, so an
-        ancestor/descendant overlap or a hole cannot cancel silently
-        except in adversarial pairs the 2:1 check below also screens)."""
+        volumes must tile the domain exactly, plus an explicit
+        no-ancestor-overlap screen — the integer volume sum alone could
+        be satisfied by a compensating overlap+hole pair, so each
+        guarantee is checked on its own rather than delegated to the
+        neighbor-engine/2:1 screens."""
         lvl = self.mapping.get_refinement_level(cells)
         if (lvl < 0).any():
             raise ValueError("leaf_set contains invalid cell ids")
@@ -186,6 +185,18 @@ class Grid:
             raise ValueError(
                 "leaf_set does not tile the domain (corrupt checkpoint?)"
             )
+        # walk every cell's ancestor chain and verify none is itself in
+        # the set (disjointness); with the exact volume sum above this
+        # makes the cover exact without relying on downstream checks
+        anc = np.unique(cells[lvl > 0])
+        while len(anc):
+            anc = np.unique(self.mapping.get_parent(anc))
+            if np.isin(anc, cells).any():
+                raise ValueError(
+                    "leaf_set contains both a cell and its ancestor "
+                    "(corrupt checkpoint?)"
+                )
+            anc = anc[self.mapping.get_refinement_level(anc) > 0]
 
     def _validate_two_to_one(self):
         """Post-build 2:1 balance check from the epoch's neighbor tables:

@@ -13,6 +13,8 @@ import subprocess
 
 import numpy as np
 
+from ..core.neighbors import InconsistentGridError
+
 __all__ = [
     "native_find_neighbors",
     "native_sort_unique_u64",
@@ -146,7 +148,7 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
         ctypes.byref(bad_cell), ctypes.byref(bad_slot),
     )
     if rc:
-        raise RuntimeError(
+        raise InconsistentGridError(
             f"inconsistent grid: no neighbor leaf for cell {bad_cell.value} "
             f"slot {tuple(hood[bad_slot.value])}"
         )
@@ -165,7 +167,7 @@ def native_find_neighbors(mapping, topology, leaves_cells, hood, src_cells, stri
         ctypes.byref(bad_cell), ctypes.byref(bad_slot),
     )
     if rc:
-        raise RuntimeError(
+        raise InconsistentGridError(
             f"neighbor {bad_cell.value} is not an existing leaf (2:1 violation?)"
         )
     return start, out_nbr, out_pos, out_offset, out_slot

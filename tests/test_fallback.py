@@ -1,0 +1,101 @@
+"""Kernel fallback-policy unit tests (``utils/fallback.py``).
+
+The policy: a compile/lowering rejection permanently disables the fast
+path; a transient runtime fault falls back for the call only, with a
+consecutive-fall cap so a deterministic-but-unrecognized failure cannot
+pay a failed fast-path attempt on every step forever.
+"""
+import pytest
+
+from dccrg_tpu.utils.fallback import _MAX_TRANSIENT_FALLS, fallback_call
+
+
+class Kernel:
+    def __init__(self):
+        self.disabled = False
+
+    def disable(self):
+        self.disabled = True
+
+
+def test_permanent_marker_disables_immediately():
+    k = Kernel()
+
+    def fast():
+        raise RuntimeError("Mosaic failed to compile: unsupported op")
+
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert k.disabled
+
+
+def test_not_implemented_disables_immediately():
+    k = Kernel()
+
+    def fast():
+        raise NotImplementedError("no lowering rule")
+
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert k.disabled
+
+
+def test_transient_fault_does_not_disable():
+    k = Kernel()
+    attempts = []
+
+    def fast():
+        attempts.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert not k.disabled  # one-off fault: the kernel gets another chance
+
+
+def test_consecutive_transient_falls_hit_the_cap():
+    k = Kernel()
+    attempts = []
+
+    def fast():
+        attempts.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    for _ in range(_MAX_TRANSIENT_FALLS + 2):
+        if k.disabled:
+            break
+        assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert k.disabled
+    assert len(attempts) == _MAX_TRANSIENT_FALLS
+
+
+def test_fast_success_resets_the_fall_count():
+    k = Kernel()
+    state = {"fail": True}
+
+    def fast():
+        if state["fail"]:
+            raise RuntimeError("transient blip")
+        return 42
+
+    # fail (cap-1) times, succeed, then fail (cap-1) times again: the
+    # reset means the cap is never reached
+    for _ in range(_MAX_TRANSIENT_FALLS - 1):
+        assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    state["fail"] = False
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 42
+    state["fail"] = True
+    for _ in range(_MAX_TRANSIENT_FALLS - 1):
+        assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert not k.disabled
+
+
+def test_both_paths_failing_propagates_the_fast_error():
+    k = Kernel()
+
+    def fast():
+        raise RuntimeError("Mosaic rejects this")
+
+    def slow():
+        raise ValueError("bad caller input")
+
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        fallback_call("k", fast, slow, k.disable)
+    assert not k.disabled  # the input was bad, not the kernel

@@ -270,3 +270,20 @@ def test_leaf_set_initialize_validates():
     bad = np.concatenate([base[1:], grandkids])
     with pytest.raises(ValueError, match="2:1|consistent"):
         fresh().initialize(mesh=make_mesh(n_devices=1), leaf_set=bad)
+    # compensating overlap+hole: cell 1 AND its children present (one
+    # extra level-0 volume) while cell 2 is absent (one missing) — the
+    # integer volume sum matches, only the ancestor screen catches it
+    overlap = np.concatenate([base[0:1], base[2:], kids]).astype(np.uint64)
+    with pytest.raises(ValueError, match="ancestor"):
+        fresh().initialize(mesh=make_mesh(n_devices=1), leaf_set=overlap)
+    # deep inconsistency that passes both the volume sum and the
+    # ancestor screen: cell 2's slot holds 7 children plus the 8
+    # grandchildren of the missing child — caught only by the neighbor
+    # engine, which must still surface it as the documented ValueError
+    kids2 = g0.mapping.get_all_children(np.uint64(2))
+    gkids = g0.mapping.get_all_children(kids2[0])
+    deep = np.concatenate([base[:1], base[2:], kids2[1:], gkids])
+    with pytest.raises(ValueError, match="consistent"):
+        fresh().initialize(
+            mesh=make_mesh(n_devices=1), leaf_set=deep.astype(np.uint64)
+        )
