@@ -366,6 +366,11 @@ class Grid:
         g.__dict__.update(self.__dict__)
         g.cell_weights = dict(self.cell_weights)
         g.pin_requests = dict(self.pin_requests)
+        if hasattr(self, "_hier_levels"):
+            g._hier_levels = list(self._hier_levels)
+            g._hier_options = [dict(o) for o in self._hier_options]
+        if hasattr(self, "_partitioning_options"):
+            g._partitioning_options = dict(self._partitioning_options)
         from .amr.refinement import AmrQueues
 
         g.amr = AmrQueues()
@@ -376,18 +381,35 @@ class Grid:
 
     def set_partitioning_option(self, name: str, value) -> "Grid":
         """Record a partitioner option (the reference forwards these as
-        Zoltan strings, ``dccrg.hpp:5537-5798``).  The native partitioners
-        honor ``IMBALANCE_TOL`` (max part load as a multiple of the
-        average — caps the graph methods' refinement and triggers the
-        striping methods' min-max-load repair); other options are kept
-        introspectable."""
+        Zoltan strings, ``dccrg.hpp:5537-5564``).  The native partitioners
+        act on ``LB_METHOD`` (overrides the method), ``IMBALANCE_TOL``
+        (max part load as a multiple of the average) and
+        ``PHG_CUT_OBJECTIVE``; known Zoltan tuning knobs are documented
+        inert and anything unrecognized warns (``parallel/loadbalance.py``).
+        Reserved names raise, as in the reference."""
+        self._check_reserved_option(name)
         if not hasattr(self, "_partitioning_options"):
             self._partitioning_options = {}
         self._partitioning_options[str(name)] = value
         return self
 
-    def get_partitioning_options(self) -> dict:
-        return dict(getattr(self, "_partitioning_options", {}))
+    @staticmethod
+    def _check_reserved_option(name):
+        from .parallel.loadbalance import RESERVED_OPTIONS, warn_unknown_option
+
+        if str(name).upper() in RESERVED_OPTIONS:
+            raise ValueError(f"option {name!r} is reserved for dccrg")
+        warn_unknown_option(name)
+
+    def get_partitioning_options(self, level: int | None = None) -> dict:
+        """The recorded global options, or — with ``level`` — the given
+        hierarchical level's own options ({} for a nonexistent level)."""
+        if level is None:
+            return dict(getattr(self, "_partitioning_options", {}))
+        opts = getattr(self, "_hier_options", [])
+        if not 0 <= int(level) < len(opts):
+            return {}
+        return dict(opts[int(level)])
 
     def get_maximum_refinement_level(self) -> int:
         return self.mapping.max_refinement_level
@@ -557,16 +579,56 @@ class Grid:
 
     def add_partitioning_level(self, processes_per_part: int):
         """Hierarchical partitioning level (reference Zoltan HIER,
-        ``dccrg.hpp:5566-5798``): devices are grouped in blocks of
+        ``dccrg.hpp:5566-5608``): devices are grouped in blocks of
         ``processes_per_part`` (e.g. chips per ICI-connected slice); cells
         are first balanced over groups, then within each group.  Multiple
         calls nest: each later level subdivides the previous level's
         groups (e.g. ``add_partitioning_level(4)`` then ``(2)`` on 8
         devices gives a 2x2x2 hierarchy: slices of 4, pairs of 2, then
-        single devices)."""
+        single devices).
+
+        Each level starts with the reference's default per-level options
+        (LB_METHOD=HYPERGRAPH, PHG_CUT_OBJECTIVE=CONNECTIVITY,
+        ``dccrg.hpp:5600-5605``); override with
+        ``add_partitioning_option(level, ...)``."""
+        if int(processes_per_part) < 1:
+            raise ValueError(
+                "must assign at least 1 process to a hierarchical "
+                "partitioning level"
+            )
         if not hasattr(self, "_hier_levels"):
             self._hier_levels = []
+            self._hier_options = []
         self._hier_levels.append(int(processes_per_part))
+        self._hier_options.append({
+            "LB_METHOD": "HYPERGRAPH",
+            "PHG_CUT_OBJECTIVE": "CONNECTIVITY",
+        })
+
+    def remove_partitioning_level(self, level: int):
+        """Remove the given hierarchical partitioning level (0-based);
+        does nothing if it doesn't exist (``dccrg.hpp:5610-5648``)."""
+        levels = getattr(self, "_hier_levels", [])
+        if 0 <= int(level) < len(levels):
+            del levels[int(level)]
+            del self._hier_options[int(level)]
+
+    def add_partitioning_option(self, level: int, name: str, value):
+        """Add (or overwrite) a partitioning option for the given
+        hierarchical level; does nothing if the level doesn't exist,
+        raises on reserved names (``dccrg.hpp:5650-5706``)."""
+        self._check_reserved_option(name)
+        opts = getattr(self, "_hier_options", [])
+        if 0 <= int(level) < len(opts):
+            opts[int(level)][str(name)] = value
+
+    def remove_partitioning_option(self, level: int, name: str):
+        """Remove a partitioning option from the given hierarchical
+        level; does nothing if the level or option doesn't exist
+        (``dccrg.hpp:5708-5744``)."""
+        opts = getattr(self, "_hier_options", [])
+        if 0 <= int(level) < len(opts):
+            opts[int(level)].pop(str(name), None)
 
     def balance_load(self, use_zoltan: bool = True):
         """Repartition cells (method from ``set_load_balancing_method``,
@@ -600,11 +662,32 @@ class Grid:
         """Multi-level partition over a device hierarchy (reference HIER,
         ``dccrg.hpp:5566-5798``): split cells over groups of ``hier[0]``
         devices (DCN level), then recurse into each group with the
-        remaining levels, ending at single devices (ICI level)."""
+        remaining levels, ending at single devices (ICI level).
+
+        ``hier`` is a list of ``(processes_per_part, level_options)``
+        pairs: each level's split runs under its own merged options
+        (global ``set_partitioning_option`` values overlaid with the
+        level's own, so a level-local IMBALANCE_TOL or LB_METHOD wins),
+        mirroring the reference's per-level Zoltan option sets.  Levels
+        exhausted with devices remaining fall through to the grid's
+        global method."""
         from .parallel.loadbalance import compute_partition
 
+        options = options or {}
+        hier = [(int(per), dict(lv_opts or {})) for per, lv_opts in hier]
+
+        def level_method(lv_opts):
+            merged = {str(k).upper(): v for k, v in options.items()}
+            merged.update({str(k).upper(): v for k, v in lv_opts.items()})
+            return str(merged.get("LB_METHOD", method)).upper(), merged
+
+        # one adjacency for the whole hierarchy, restricted per group —
+        # built only if some level (or the fall-through method, which the
+        # global LB_METHOD option can itself override) needs it
+        methods_used = [level_method(lv_opts)[0] for _, lv_opts in hier]
+        methods_used.append(level_method({})[0])
         adjacency = None
-        if method.upper() in ("GRAPH", "HYPERGRAPH"):
+        if any(m in ("GRAPH", "HYPERGRAPH") for m in methods_used):
             from .parallel.graph import grid_adjacency
 
             adjacency = grid_adjacency(self)
@@ -616,11 +699,13 @@ class Grid:
                 owner[idx] = first
                 return
             if not levels:
+                ft_method, ft_options = level_method({})
                 owner[idx] = first + compute_partition(
-                    method, sub, n_devices, w, options, adj
+                    ft_method, sub, n_devices, w, ft_options, adj
                 )
                 return
-            per = max(1, min(levels[0], n_devices))
+            lv_method, lv_options = level_method(levels[0][1])
+            per = max(1, min(levels[0][0], n_devices))
             # groups of `per` devices plus a remainder group when per does
             # not divide the device count — no device may be left idle
             group_sizes = [per] * (n_devices // per)
@@ -632,7 +717,9 @@ class Grid:
             # partition at device granularity, then merge consecutive parts
             # into groups proportional to each group's device count (equal
             # n_groups-way cuts would misweight a remainder group)
-            fine = compute_partition(method, sub, n_devices, w, options, adj)
+            fine = compute_partition(
+                lv_method, sub, n_devices, w, lv_options, adj
+            )
             bounds = np.cumsum([0] + group_sizes)
             group = np.searchsorted(bounds, fine, side="right") - 1
             for gi, n_dev_g in enumerate(group_sizes):
@@ -691,7 +778,10 @@ class Grid:
         options = self.get_partitioning_options()
         hier = getattr(self, "_hier_levels", None)
         if hier and method.upper() != "NONE":
-            owner = self._hierarchical_partition(method, weights, hier, options)
+            hier_opts = getattr(self, "_hier_options", [{} for _ in hier])
+            owner = self._hierarchical_partition(
+                method, weights, list(zip(hier, hier_opts)), options
+            )
         else:
             owner = compute_partition(
                 method, self, self.n_devices, weights, options

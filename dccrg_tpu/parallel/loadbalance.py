@@ -31,11 +31,59 @@ DCN level), then within each group (chips on ICI), recursively for every
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from .partition import hilbert_partition, morton_partition, weighted_blocks
 
-__all__ = ["compute_partition", "rcb_partition"]
+__all__ = ["compute_partition", "rcb_partition", "RESERVED_OPTIONS"]
+
+#: Zoltan parameters the reference reserves for dccrg itself
+#: (``dccrg.hpp:7716-7723``) — ``set_partitioning_option`` /
+#: ``add_partitioning_option`` raise on these.
+RESERVED_OPTIONS = frozenset({
+    "EDGE_WEIGHT_DIM", "NUM_GID_ENTRIES", "NUM_LID_ENTRIES",
+    "OBJ_WEIGHT_DIM", "RETURN_LISTS", "NUM_GLOBAL_PARTS",
+    "NUM_LOCAL_PARTS", "AUTO_MIGRATE",
+})
+
+#: options that ACT on the native partitioners: ``LB_METHOD`` overrides
+#: the method (as Zoltan_Set_Param would), ``IMBALANCE_TOL`` caps part
+#: loads, ``PHG_CUT_OBJECTIVE`` selects the hypergraph objective
+#: (CONNECTIVITY = communication volume, Zoltan's default;
+#: HYPEREDGES = edge cut).
+_ACTING_OPTIONS = frozenset({"LB_METHOD", "IMBALANCE_TOL",
+                             "PHG_CUT_OBJECTIVE"})
+
+#: Zoltan tuning knobs that are meaningful requests but have no effect
+#: on the native methods — DOCUMENTED INERT rather than unknown: the
+#: native RCB is already deterministic and rectilinear
+#: (coordinate-plane cuts), cuts are recomputed per balance (KEEP_CUTS
+#: is a Zoltan-side cache), and the debug/check levels have no Zoltan
+#: process to configure.
+_INERT_OPTIONS = frozenset({
+    "RCB_RECTILINEAR_BLOCKS", "RCB_LOCK_DIRECTIONS", "RCB_SET_DIRECTIONS",
+    "RCB_REUSE", "AVERAGE_CUTS", "KEEP_CUTS", "REDUCE_DIMENSIONS",
+    "DETERMINISTIC", "CHECK_GEOM", "CHECK_GRAPH", "CHECK_HYPERGRAPH",
+    "DEBUG_LEVEL", "DEBUG_PROCESSOR", "DEBUG_MEMORY", "TIMER",
+    "PHG_OUTPUT_LEVEL", "GRAPH_SYMMETRIZE", "PHG_MULTILEVEL",
+    "LB_APPROACH", "MIGRATE_ONLY_PROC_CHANGES",
+})
+
+def warn_unknown_option(name) -> None:
+    """Warn when an option name is neither acting, documented-inert, nor
+    reserved — called at option-set time (``set_partitioning_option`` /
+    ``add_partitioning_option``) so a misspelled knob surfaces once per
+    user action, at the line that set it."""
+    up = str(name).upper()
+    if (up not in _ACTING_OPTIONS and up not in _INERT_OPTIONS
+            and up not in RESERVED_OPTIONS):
+        warnings.warn(
+            f"partitioning option {name!r} is not recognized by the "
+            "native partitioners and has no effect",
+            stacklevel=3,
+        )
 
 
 def rcb_partition(
@@ -83,6 +131,9 @@ def compute_partition(
     # Zoltan treats parameter names case-insensitively (reference forwards
     # them verbatim to Zoltan_Set_Param) — match that
     options = {str(k).upper(): v for k, v in (options or {}).items()}
+    # LB_METHOD as an option overrides the grid's method, as forwarding
+    # it to Zoltan_Set_Param would in the reference
+    method = str(options.get("LB_METHOD", method)).upper()
     tol = options.get("IMBALANCE_TOL")
     tol = None if tol is None else float(tol)
     if method == "NONE":
@@ -112,11 +163,18 @@ def compute_partition(
     if method in ("GRAPH", "HYPERGRAPH"):
         from .graph import graph_partition
 
+        objective = "volume" if method == "HYPERGRAPH" else "cut"
+        phg = str(options.get("PHG_CUT_OBJECTIVE", "")).upper()
+        if method == "HYPERGRAPH" and phg:
+            # Zoltan PHG vocabulary: CONNECTIVITY = communication volume
+            # (its default), HYPEREDGES = plain edge cut
+            objective = {"CONNECTIVITY": "volume",
+                         "HYPEREDGES": "cut"}.get(phg, objective)
         return graph_partition(
             grid,
             n_parts,
             weights,
-            objective="volume" if method == "HYPERGRAPH" else "cut",
+            objective=objective,
             imbalance_tol=1.1 if tol is None else tol,
             adjacency=adjacency,
         )

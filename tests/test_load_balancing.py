@@ -435,3 +435,140 @@ def test_three_phase_finish_drains_remaining():
     with pytest.raises(RuntimeError, match="in progress"):
         g.stop_refining()
     g.finish_balance_load()
+
+
+# ---------------------------------------------------- per-level options
+
+
+def _record_partitions(monkeypatch):
+    """Wrap compute_partition to record (method, n_parts, options)."""
+    from dccrg_tpu.parallel import loadbalance
+
+    calls = []
+    orig = loadbalance.compute_partition
+
+    def recording(method, grid, n_parts, weights, options=None, adjacency=None):
+        calls.append((method.upper(), n_parts,
+                      {str(k).upper(): v for k, v in (options or {}).items()}))
+        return orig(method, grid, n_parts, weights, options, adjacency)
+
+    monkeypatch.setattr(loadbalance, "compute_partition", recording)
+    return calls
+
+
+def test_per_level_methods_and_options(monkeypatch):
+    """Reference parity (dccrg.hpp:5650-5706): each hierarchy level runs
+    under its own method and options — DCN level GRAPH with tol 1.05,
+    ICI level HILBERT with tol 1.2."""
+    g = make_grid("RCB", length=(8, 8, 8))
+    g.add_partitioning_level(4)   # level 0: 2 groups of 4 (DCN)
+    g.add_partitioning_level(1)   # level 1: single devices (ICI)
+    g.add_partitioning_option(0, "LB_METHOD", "GRAPH")
+    g.add_partitioning_option(0, "IMBALANCE_TOL", 1.05)
+    g.add_partitioning_option(1, "LB_METHOD", "HILBERT")
+    g.add_partitioning_option(1, "IMBALANCE_TOL", 1.2)
+
+    calls = _record_partitions(monkeypatch)
+    g.balance_load()
+
+    # level 0 splits all 8 devices under GRAPH/1.05; level 1 splits each
+    # 4-device group under HILBERT/1.2
+    assert [(m, n) for m, n, _ in calls] == [
+        ("GRAPH", 8), ("HILBERT", 4), ("HILBERT", 4)
+    ]
+    assert calls[0][2]["IMBALANCE_TOL"] == 1.05
+    assert all(c[2]["IMBALANCE_TOL"] == 1.2 for c in calls[1:])
+
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=8)
+    assert counts.sum() == 512
+    assert counts.min() > 0
+    assert counts.max() <= 1.2 * 512 / 8
+
+
+def test_partitioning_level_defaults(monkeypatch):
+    """A fresh level carries the reference's default options
+    (LB_METHOD=HYPERGRAPH, PHG_CUT_OBJECTIVE=CONNECTIVITY,
+    dccrg.hpp:5600-5605) — the group split runs HYPERGRAPH even when the
+    grid's global method is RCB."""
+    g = make_grid("RCB", length=(8, 8, 1))
+    g.add_partitioning_level(4)
+    assert g.get_partitioning_options(0) == {
+        "LB_METHOD": "HYPERGRAPH",
+        "PHG_CUT_OBJECTIVE": "CONNECTIVITY",
+    }
+    calls = _record_partitions(monkeypatch)
+    g.balance_load()
+    assert calls[0][0] == "HYPERGRAPH"
+    # fall-through within each group uses the grid's global method
+    assert {c[0] for c in calls[1:]} == {"RCB"}
+
+
+def test_partitioning_level_and_option_removal():
+    """remove_partitioning_level/option edit the hierarchy in place;
+    out-of-range indices are no-ops (dccrg.hpp:5610-5744)."""
+    g = make_grid("RCB")
+    g.add_partitioning_level(4)
+    g.add_partitioning_level(2)
+    g.add_partitioning_option(1, "IMBALANCE_TOL", 1.3)
+    assert g.get_partitioning_options(1)["IMBALANCE_TOL"] == 1.3
+
+    g.remove_partitioning_option(1, "PHG_CUT_OBJECTIVE")
+    assert "PHG_CUT_OBJECTIVE" not in g.get_partitioning_options(1)
+    g.remove_partitioning_option(1, "NOT_THERE")       # no-op
+    g.remove_partitioning_option(7, "IMBALANCE_TOL")   # no-op
+
+    g.remove_partitioning_level(0)
+    # former level 1 shifted down, its options intact
+    assert g._hier_levels == [2]
+    assert g.get_partitioning_options(0)["IMBALANCE_TOL"] == 1.3
+    g.remove_partitioning_level(5)                     # no-op
+    assert g._hier_levels == [2]
+
+    with pytest.raises(ValueError, match="at least 1"):
+        g.add_partitioning_level(0)
+    g.add_partitioning_option(9, "IMBALANCE_TOL", 1.1)  # no-op, no raise
+    assert g.get_partitioning_options(9) == {}
+
+
+def test_reserved_options_raise():
+    """Zoltan parameters the reference reserves for dccrg itself raise
+    from both option APIs (dccrg.hpp:7716-7723)."""
+    g = make_grid("RCB")
+    g.add_partitioning_level(4)
+    with pytest.raises(ValueError, match="reserved"):
+        g.set_partitioning_option("RETURN_LISTS", "ALL")
+    with pytest.raises(ValueError, match="reserved"):
+        g.add_partitioning_option(0, "AUTO_MIGRATE", "1")
+
+
+def test_unknown_option_warns():
+    """Unrecognized option names warn when set (global or per-level);
+    documented-inert Zoltan knobs do not."""
+    import warnings as _w
+
+    g = make_grid("RCB")
+    g.add_partitioning_level(4)
+    with pytest.warns(UserWarning, match="SOME_BOGUS_KNOB"):
+        g.set_partitioning_option("SOME_BOGUS_KNOB", "7")
+    with pytest.warns(UserWarning, match="OTHER_BOGUS_KNOB"):
+        g.add_partitioning_option(0, "OTHER_BOGUS_KNOB", "x")
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        g.set_partitioning_option("RCB_RECTILINEAR_BLOCKS", "1")  # inert
+        g.balance_load()
+
+
+def test_global_lb_method_override_on_fallthrough(monkeypatch):
+    """A global LB_METHOD=GRAPH option must also steer the hierarchy's
+    exhausted-levels fall-through (the adjacency pre-build gate resolves
+    the override, so graph_partition gets a real adjacency)."""
+    g = make_grid("RCB", length=(8, 8, 8))
+    g.set_partitioning_option("LB_METHOD", "GRAPH")
+    g.add_partitioning_level(4)
+    g.add_partitioning_option(0, "LB_METHOD", "HILBERT")
+    calls = _record_partitions(monkeypatch)
+    g.balance_load()
+    assert [(m, n) for m, n, _ in calls] == [("HILBERT", 8), ("GRAPH", 4),
+                                             ("GRAPH", 4)]
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=8)
+    assert counts.sum() == 512 and counts.min() > 0
