@@ -1352,6 +1352,14 @@ class Grid:
 
         for name, arr in state.items():
             host_old = fetch(arr, dtype=arr.dtype)
+            if host_old.ndim < 2 or host_old.shape[:2] != (
+                old.n_devices, old.R
+            ):
+                # not a per-cell [D, R, ...] payload (e.g. a global
+                # counter like the particles' overflow scalar) — carry
+                # it through unchanged
+                out[name] = arr
+                continue
             field_shape = host_old.shape[2:]
             host_new = np.zeros((new.n_devices, new.R) + field_shape, host_old.dtype)
             pol = policy.get(name, {})

@@ -12,12 +12,14 @@ plus an ``[D, R]`` count — the padding-based ragged-buffer strategy the
 build plan prescribes.  The push is a jitted array op; the ghost update
 moves counts first and coordinates second through the same halo engine
 (both are exact copies).  Re-bucketing particles into their new cells is
-fully device-side on uniform periodic grids (a per-device sort over the
-padded slots inside ``shard_map`` — each device claims the particles of
-its local + ghost rows that land in its own cells, the array form of the
-reference's neighbor handoff), with ``run()`` advancing whole histories
-in one dispatch; other grids re-bucket through the host path, like every
-structural mutation in this design.
+fully device-side on uniform-Cartesian grids — refined, mixed-periodicity,
+and arbitrarily partitioned included: a per-device sort over the padded
+slots inside ``shard_map``, keyed on the epoch's sorted row-id tables via
+the jittable cell-id algebra, claims the particles of local + ghost rows
+that land in this device's own cells (the array form of the reference's
+neighbor handoff), with ``run()`` advancing whole histories in one
+dispatch; stretched geometries re-bucket through the host path, like
+every structural mutation in this design.
 """
 from __future__ import annotations
 
@@ -118,18 +120,29 @@ class Particles:
     # --------------------------------------------- device-side re-bucketing
 
     def _build_device_rebucket(self):
-        """Jitted re-bucket for uniform fully-periodic grids under the
-        id-order block striping: per device, one sort of the padded slots
-        keys particles by target local row; ghost rows supply the
-        neighbors' emigrants (so the CFL-style constraint is the halo
-        width, exactly the reference's neighbor-handoff reach,
-        ``tests/particles/simple.cpp:52-97``).  Returns None when the
-        grid does not qualify — the host path stays the general
-        mechanism.  Overflowing a cell's ``P`` slots drops the excess and
-        counts it in the state's ``overflow`` scalar."""
+        """Jitted re-bucket keyed on the epoch's leaf tables: per device,
+        one sort of the padded slots keys particles by target local row;
+        ghost rows supply the neighbors' emigrants (so the CFL-style
+        constraint is the halo width, exactly the reference's
+        neighbor-handoff reach, ``tests/particles/simple.cpp:52-97``).
+
+        The target cell of a position is found with the id algebra
+        (``core/mapping.py``): the candidate cell id at every refinement
+        level is pure shift/add arithmetic on the max-resolution voxel
+        triple, and exactly one candidate can appear in this device's
+        sorted row-id table (leaves are disjoint) — so AMR grids and any
+        post-``balance_load`` ownership stay on device.  Mixed
+        periodicity is handled per axis; a particle escaping through a
+        non-periodic boundary or out-running the ghost halo is dropped
+        and counted in the state's ``overflow`` scalar, as is capacity
+        overflow of a cell's ``P`` slots.
+
+        Returns None when the grid does not qualify (stretched geometry,
+        whose per-cell sizes the voxel arithmetic cannot express, or an
+        id space past the integer width jax can use) — the host path
+        stays the general mechanism."""
         from jax import shard_map
         from jax.sharding import PartitionSpec as Pspec
-
 
         grid = self.grid
         epoch = grid.epoch
@@ -139,49 +152,87 @@ class Particles:
         if N == 0:
             return None
         # uniform Cartesian only: the device path buckets by a single
-        # cell size, which a stretched geometry does not have
+        # level-0 cell size, which a stretched geometry does not have
         if not getattr(grid.geometry, "uniform_level0", False):
             return None
-        if mapping.get_refinement_level(leaves.cells).max() != 0:
-            return None
-        if not all(grid.topology.periodic):
-            return None
         D, R, P = epoch.n_devices, epoch.R, self.P
-        if N % D != 0 or not np.array_equal(
-            leaves.cells, np.arange(1, N + 1, dtype=np.uint64)
-        ):
+        # candidate ids (and the dead-row sentinels past them) must fit
+        # the device integer width: int32 always works on TPU; int64
+        # needs jax x64 mode
+        if int(mapping.last_cell) + R + 2 < 2**31:
+            id_dtype = jnp.int32
+        elif jax.config.jax_enable_x64 and int(mapping.last_cell) + R + 2 < 2**62:
+            id_dtype = jnp.int64
+        else:
             return None
-        per = N // D
-        expected = np.repeat(np.arange(D, dtype=leaves.owner.dtype), per)
-        if not np.array_equal(leaves.owner, expected):
-            return None
-        # local rows 0..per-1 hold global ids dev*per+1.. in order
+        L = mapping.max_refinement_level
         geo = grid.geometry
         nx, ny, nz = (int(v) for v in mapping.length)
         start = np.asarray(geo.get_start(), np.float64)
-        clen = np.asarray(geo.get_level_0_cell_length(), np.float64)
-        dom = clen * np.array([nx, ny, nz], np.float64)
-        dims = np.array([nx, ny, nz], np.int32)
+        clen0 = np.asarray(geo.get_level_0_cell_length(), np.float64)
+        dom = clen0 * np.array([nx, ny, nz], np.float64)
+        # voxel = max-refinement-resolution index (the mapping's unit)
+        vox_len = clen0 / (1 << L)
+        vox_dims = np.array([nx << L, ny << L, nz << L], np.int64)
+        periodic = np.asarray(grid.topology.periodic, dtype=bool)
+        level_offsets = mapping._level_offsets.astype(np.int64)  # [L+2]
 
-        local_rows = np.asarray(self.tables.local_mask)   # [D, R]
+        # per-device sorted row-id table: dead rows (id 0) get a sentinel
+        # past every real id so they sort last and never match
+        cell_ids = np.asarray(epoch.cell_ids).astype(np.int64)   # [D, R]
+        sentinel = int(mapping.last_cell) + 1
+        keyed = np.where(cell_ids == 0, sentinel + np.arange(R)[None, :],
+                         cell_ids)
+        sort_order = np.argsort(keyed, axis=1)
+        ids_sorted = np.take_along_axis(keyed, sort_order, axis=1)
+        rows_sorted = sort_order.astype(np.int32)
+        local_rows = np.asarray(self.tables.local_mask)          # [D, R]
+        # only levels that actually occur need a candidate search
+        levels_present = sorted(
+            int(v) for v in
+            np.unique(mapping.get_refinement_level(leaves.cells))
+        )
 
-        def body(pos, cnt, local):
-            pos, cnt, local = pos[0], cnt[0], local[0]    # [R,P,3], [R]
-            dev = jax.lax.axis_index(SHARD_AXIS)
+        def body(pos, cnt, ids_s, rows_s, local):
+            pos, cnt = pos[0], cnt[0]                 # [R,P,3], [R]
+            ids_s, rows_s, local = ids_s[0], rows_s[0], local[0]
             dt_ = pos.dtype
             valid = (jnp.arange(P)[None, :] < cnt[:, None]).reshape(-1)
             p = pos.reshape(R * P, 3)
-            wp = jnp.asarray(start, dt_) + jnp.mod(
-                p - jnp.asarray(start, dt_), jnp.asarray(dom, dt_)
-            )
-            ix = jnp.floor(
-                (wp - jnp.asarray(start, dt_)) / jnp.asarray(clen, dt_)
-            ).astype(jnp.int32)
-            ix = jnp.clip(ix, 0, jnp.asarray(dims - 1))
-            gid0 = ix[:, 0] + nx * (ix[:, 1] + ny * ix[:, 2])
-            tloc = gid0 - dev * per
-            inside = valid & (tloc >= 0) & (tloc < per)
-            key = jnp.where(inside, tloc, R)          # R = drop sentinel
+            # the domain is CLOSED ([start, end] per axis), exactly like
+            # the host path's geometry: a coordinate sitting on the upper
+            # edge belongs to the last cell, so wrap a periodic axis only
+            # when the raw coordinate is strictly outside (a plain mod
+            # would fold end onto start and diverge from the host bucket)
+            lo = jnp.asarray(start, dt_)
+            hi = jnp.asarray(start + dom, dt_)
+            raw_in = (p >= lo) & (p <= hi)
+            wrapped = lo + jnp.mod(p - lo, jnp.asarray(dom, dt_))
+            wp = jnp.where(jnp.asarray(periodic) & ~raw_in, wrapped, p)
+            # only a non-periodic axis can lose a particle
+            in_dom = (jnp.asarray(periodic) | raw_in).all(axis=1)
+            rel = (wp - lo) / jnp.asarray(vox_len, dt_)
+            ivox = jnp.floor(rel).astype(id_dtype)
+            ivox = jnp.clip(ivox, 0, jnp.asarray(vox_dims - 1, id_dtype))
+            # candidate cell id at each level PRESENT in the leaf set:
+            # shift the voxel triple to level resolution, linearize
+            # x-fastest, add the level block offset
+            # (mapping.get_cell_from_indices, jittable form)
+            row = jnp.zeros(R * P, jnp.int32)
+            found = jnp.zeros(R * P, bool)
+            for lvl in levels_present:
+                s = L - lvl
+                cx, cy, cz = ivox[:, 0] >> s, ivox[:, 1] >> s, ivox[:, 2] >> s
+                lx = id_dtype(nx << lvl)
+                ly = id_dtype(ny << lvl)
+                cand = id_dtype(level_offsets[lvl]) + cx + lx * (cy + ly * cz)
+                pos_s = jnp.searchsorted(ids_s, cand)
+                hit = ids_s[jnp.minimum(pos_s, R - 1)] == cand
+                row = jnp.where(hit & ~found,
+                                rows_s[jnp.minimum(pos_s, R - 1)], row)
+                found = found | hit
+            claimed = valid & in_dom & found & local[row]
+            key = jnp.where(claimed, row, R)          # R = drop sentinel
             order = jnp.argsort(key)
             ks = key[order]
             ws = wp[order]
@@ -194,10 +245,10 @@ class Particles:
             )
             new_cnt = jnp.minimum(counts, P)
             # lost = canonical population before (local rows only; ghost
-            # rows are duplicates) minus population after — catches both
-            # capacity overflow and particles that out-ran the ghost halo
-            # (the device path's reach limit, like the reference's
-            # neighbor handoff)
+            # rows are duplicates) minus population after — catches
+            # capacity overflow, non-periodic escapes, and particles that
+            # out-ran the ghost halo (the device path's reach limit, like
+            # the reference's neighbor handoff)
             before = jax.lax.psum(
                 jnp.sum(cnt * local).astype(jnp.int32), SHARD_AXIS
             )
@@ -209,16 +260,19 @@ class Particles:
         fn = shard_map(
             body,
             mesh=grid.mesh,
-            in_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec(SHARD_AXIS)),
+            in_specs=(Pspec(SHARD_AXIS),) * 5,
             out_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec()),
             check_vma=False,
         )
-        local_arr = put_table(local_rows, grid.mesh, jnp.int32)
+        ids_arr = put_table(ids_sorted, grid.mesh, id_dtype)
+        rows_arr = put_table(rows_sorted, grid.mesh, jnp.int32)
+        local_arr = put_table(local_rows, grid.mesh, bool)
 
         @jax.jit
         def rebucket_fn(state):
             new_pos, new_cnt, lost = fn(
-                state["particles"], state["number_of_particles"], local_arr
+                state["particles"], state["number_of_particles"],
+                ids_arr, rows_arr, local_arr,
             )
             return {
                 **state,
@@ -336,4 +390,6 @@ class Particles:
         if hasattr(self, "_run"):
             del self._run
         fresh = self.grid.new_state(self.spec())
+        if "overflow" in state:
+            fresh["overflow"] = state["overflow"]
         return self._scatter(fresh, pts)

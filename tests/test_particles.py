@@ -107,9 +107,27 @@ def test_capacity_guard():
         p.new_state(pts)
 
 
-def test_nonperiodic_escape_raises():
+def test_nonperiodic_escape_drops_on_device_path():
+    """A particle crossing a non-periodic boundary is removed, as the
+    reference's handoff does when get_existing_cell finds no cell
+    (tests/particles/simple.cpp:74-92); the device path counts the drop
+    in the state's overflow scalar."""
     g = make_grid(periodic=(False, False, False))
     p = Particles(g)
+    assert p._dev_rebucket is not None
+    state = p.new_state(np.array([[0.95, 0.5, 0.5]]))
+    for _ in range(3):
+        state = p.step(state, velocity=(0.1, 0.0, 0.0), dt=1.0)
+    assert p.count(state) == 0
+    assert int(state["overflow"]) == 1
+
+
+def test_nonperiodic_escape_raises_on_host_path():
+    """The host path keeps its stricter contract: an escape through a
+    non-periodic boundary raises instead of silently dropping."""
+    g = make_grid(periodic=(False, False, False))
+    p = Particles(g)
+    p._dev_rebucket = None  # force host orchestration
     state = p.new_state(np.array([[0.95, 0.5, 0.5]]))
     with pytest.raises(ValueError, match="non-periodic"):
         for _ in range(3):
@@ -293,3 +311,98 @@ def test_device_rebucket_counts_beyond_halo_loss():
     s = pc.run(s, 1, velocity=(0.0, 0.0, 0.5), dt=1.0)
     assert pc.count(s) == 0
     assert int(np.asarray(s["overflow"])) == 1
+
+
+def test_device_rebucket_on_refined_grid():
+    """The generalized device re-bucket keys on the epoch's leaf tables,
+    so an AMR grid stays on device (reference particles under refinement,
+    tests/particles/simple.cpp:52-97) — bit-identical to the host path."""
+    def build(nd):
+        g = make_grid((4, 4, 2), periodic=(True, True, True), max_ref=2,
+                      n_dev=nd)
+        for c in (1, 2, 7, 12):
+            g.refine_completely(c)
+        g.stop_refining()
+        kid = int(g.mapping.get_all_children(np.uint64(1))[0])
+        g.refine_completely(kid)
+        g.stop_refining()
+        return g
+
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(0, 1, size=(300, 3))
+    vel = (0.05, -0.03, 0.04)
+
+    results = {}
+    for nd in (1, 4):
+        g = build(nd)
+        pc = Particles(g, max_particles_per_cell=64)
+        assert pc._dev_rebucket is not None, "AMR grid must stay on device"
+        s = pc.new_state(pts)
+        s = pc.run(s, 10, velocity=vel, dt=0.5)
+        assert pc.count(s) == 300
+        assert int(np.asarray(s["overflow"])) == 0
+        results[nd] = np.sort(pc.positions(s), axis=0)
+
+    g = build(1)
+    pc = Particles(g, max_particles_per_cell=64)
+    pc._dev_rebucket = None          # force the host mechanism
+    s = pc.new_state(pts)
+    for _ in range(10):
+        s = pc.step(s, velocity=vel, dt=0.5)
+    host = np.sort(pc.positions(s), axis=0)
+    for nd, r in results.items():
+        np.testing.assert_array_equal(r, host, err_msg=f"n_dev={nd}")
+
+
+def test_device_rebucket_after_balance_load():
+    """Post-balance_load ownership (arbitrary, non-block-striped) stays
+    on the device path: remap() rebuilds the row tables and the run()
+    loop keeps matching the host path (reference runs particles under
+    balance_load as a matter of course, simple.cpp:285-294)."""
+    def run_one(host_path):
+        g = make_grid((8, 8, 2), periodic=(True, True, True), n_dev=4)
+        pc = Particles(g, max_particles_per_cell=32)
+        rng = np.random.default_rng(23)
+        pts = rng.uniform(0, 1, size=(200, 3))
+        s = pc.new_state(pts)
+        s = pc.run(s, 5, velocity=(0.07, 0.05, 0.0), dt=0.5)
+        # scatter ownership away from block striping
+        for cell in g.get_cells()[::3]:
+            g.pin(int(cell), int(cell) % 4)
+        g.balance_load()
+        s = pc.remap(s)   # re-buckets into the new layout itself
+        if host_path:
+            pc._dev_rebucket = None
+        else:
+            assert pc._dev_rebucket is not None, \
+                "pinned/scattered ownership must stay on device"
+        if pc._dev_rebucket is not None:
+            s = pc.run(s, 10, velocity=(0.07, 0.05, 0.0), dt=0.5)
+        else:
+            for _ in range(10):
+                s = pc.step(s, velocity=(0.07, 0.05, 0.0), dt=0.5)
+        assert pc.count(s) == 200
+        return np.sort(pc.positions(s), axis=0)
+
+    dev = run_one(host_path=False)
+    host = run_one(host_path=True)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_exact_upper_edge_matches_host():
+    """The domain is closed ([start, end]): a particle exactly on the
+    upper edge belongs to the last cell on BOTH re-bucket paths,
+    periodic or not (a plain mod would fold end onto start on the
+    device path and diverge from the host bucket)."""
+    for periodic in ((True, True, True), (False, False, False)):
+        g = make_grid((4, 4, 4), periodic=periodic, n_dev=1)
+        pc = Particles(g)
+        assert pc._dev_rebucket is not None
+        pt = np.array([[1.0, 0.5, 0.5]])
+        s = pc.new_state(pt)           # host scatter accepts the edge
+        host_cell = int(g.get_existing_cell(pt)[0])
+        s = pc.rebucket(s)             # device path must agree
+        assert pc.count(s) == 1, periodic
+        assert int(np.asarray(s["overflow"])) == 0, periodic
+        got = pc.particles_of(s, host_cell)
+        assert len(got) == 1 and np.allclose(got[0], pt[0]), periodic

@@ -290,12 +290,26 @@ def one(seed):
         g.stop_refining()
     npart = int(rng.integers(200, 1500))
     m = Particles(g, max_particles_per_cell=256)
+    # uniform Cartesian fully-periodic grids — refined or not — must
+    # qualify for the generalized device re-bucket
+    assert m._dev_rebucket is not None, (seed, 'device path gated off')
     state = m.new_state(rng.random((npart, 3)))
     assert m.count(state) == npart
     vel = m.velocity_field(lambda c: 0.2 * (c - 0.5))
     for turn in range(4):
         state = m.step(state, velocity=vel, dt=0.1)
         assert m.count(state) == npart, (seed, turn)
+    # device-vs-host differential on this (possibly refined) grid
+    mh = Particles(g, max_particles_per_cell=256)
+    mh._dev_rebucket = None
+    sh = mh.new_state(m.positions(state))
+    state = m.run(state, 2, velocity=(0.03, -0.02, 0.01), dt=0.5)
+    for _ in range(2):
+        sh = mh.step(sh, velocity=(0.03, -0.02, 0.01), dt=0.5)
+    np.testing.assert_array_equal(
+        np.sort(m.positions(state), axis=0),
+        np.sort(mh.positions(sh), axis=0))
+    assert m.count(state) == npart, (seed, 'post-differential')
     # bucket validity: every particle inside its cell
     ids = g.get_cells()
     for cell in rng.choice(ids, size=min(30, len(ids)), replace=False):
