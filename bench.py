@@ -43,6 +43,8 @@ REFINED_STEPS = 2000
 LARGE = (512, 512, 128)  # f32 density alone is 128 MiB: cannot fit VMEM
 LARGE_STEPS = 200
 GOL_N = 500              # the reference example's board (game_of_life.cpp)
+VLASOV_N = 32            # spatial grid (BASELINE.md config 5)
+VLASOV_NV = 8            # velocity bins per dimension (nv^3 per cell)
 GOL_TURNS = 20000
 
 
@@ -398,20 +400,28 @@ def measure_vlasov() -> dict:
 
     from dccrg_tpu.models import Vlasov
 
-    g = _uniform_grid((32, 32, 32))
-    nv = 8
+    g = _uniform_grid((VLASOV_N,) * 3)
+    nv = VLASOV_NV
     v = Vlasov(g, nv=nv, dtype=np.float32)
     state = v.initialize_state()
     dt = np.float32(0.4 * v.max_time_step())
     steps = 50
     jax.block_until_ready(v.run(state, 2, dt)["f"])
     secs, times, _ = _median_of(lambda: v.run(state, steps, dt)["f"], n=3)
-    n_phase = 32 ** 3 * nv ** 3
+    n_phase = VLASOV_N ** 3 * nv ** 3
+    try:
+        cpu = measure_cpu_vlasov_baseline()
+    except Exception as e:  # noqa: BLE001
+        print(f"vlasov cpu baseline failed: {e}", file=sys.stderr)
+        cpu = None
+    rate = n_phase * steps / secs
     return {
-        "n_spatial": 32 ** 3,
+        "n_spatial": VLASOV_N ** 3,
         "nv": nv,
         "phase_space_cells": n_phase,
-        "phase_updates_per_s": n_phase * steps / secs,
+        "phase_updates_per_s": rate,
+        "cpu_baseline_phase_updates_per_s": cpu,
+        "vs_baseline": round(rate / cpu, 3) if cpu else -1,
         "times_s": [round(t, 4) for t in times],
     }
 
@@ -617,6 +627,15 @@ def measure_cpu_baseline() -> float:
 def measure_cpu_gol_baseline() -> float:
     return _cpu_denominator(
         f"gol_{GOL_N}x{GOL_N}", "cpu_gol_baseline", [GOL_N, GOL_N, 200]
+    )
+
+
+def measure_cpu_vlasov_baseline() -> float:
+    """Reference-pattern per-cell f(v) block loops (see
+    tools/cpu_vlasov_baseline.cpp) on the measure_vlasov config."""
+    return _cpu_denominator(
+        f"vlasov_{VLASOV_N}^3_nv{VLASOV_NV}", "cpu_vlasov_baseline",
+        [VLASOV_N, VLASOV_N, VLASOV_N, VLASOV_NV, 50],
     )
 
 

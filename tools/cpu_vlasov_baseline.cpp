@@ -1,0 +1,102 @@
+// CPU baseline for the Vlasov benchmark: the reference's per-cell velocity
+// block pattern (Vlasiator payload shape over dccrg, CREDITS:4-6) on a
+// uniform periodic 3-D grid — each spatial cell owns a flattened [nv^3]
+// f(v) block, and one step is the dimension-split upwind sweep where every
+// velocity bin advects with its own constant velocity, per-cell loops with
+// 6-face neighbor indirection, double precision, multi-threaded over all
+// host cores.
+//
+// The actual reference (dccrg + MPI + Zoltan + Vlasiator) cannot be built
+// in this image; this program re-creates its compute pattern as the honest
+// MPI-CPU denominator for BASELINE.md's protocol, exactly like
+// cpu_baseline.cpp does for the advection config.
+//
+// Usage: cpu_vlasov_baseline NX NY NZ NV STEPS -> prints phase-space
+// cell-updates/sec (a "step" = all three dimensional sweeps, matching
+// dccrg_tpu/models/vlasov.py).
+
+#include <cstdio>
+#include <cstdlib>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+int main(int argc, char** argv) {
+    const int64_t nx = argc > 1 ? atoll(argv[1]) : 32;
+    const int64_t ny = argc > 2 ? atoll(argv[2]) : 32;
+    const int64_t nz = argc > 3 ? atoll(argv[3]) : 32;
+    const int64_t nv = argc > 4 ? atoll(argv[4]) : 8;
+    const int64_t steps = argc > 5 ? atoll(argv[5]) : 10;
+    const int64_t n = nx * ny * nz;
+    const int64_t B = nv * nv * nv;
+
+    // per-axis bin velocity (bin centers in [-vmax, vmax], vmax = 1.0,
+    // x-fastest flattening — dccrg_tpu/models/vlasov.py:50-54)
+    const double v_max = 1.0;
+    std::vector<double> vbin(B * 3);
+    for (int64_t bz = 0; bz < nv; bz++)
+    for (int64_t by = 0; by < nv; by++)
+    for (int64_t bx = 0; bx < nv; bx++) {
+        const int64_t b = bx + nv * (by + nv * bz);
+        vbin[b * 3 + 0] = (bx + 0.5) / nv * 2 * v_max - v_max;
+        vbin[b * 3 + 1] = (by + 0.5) / nv * 2 * v_max - v_max;
+        vbin[b * 3 + 2] = (bz + 0.5) / nv * 2 * v_max - v_max;
+    }
+
+    // AoS cell blocks + 6-face periodic neighbor indirection, the
+    // reference's neighbors_of pattern
+    std::vector<double> f(n * B), g(n * B);
+    std::vector<int64_t> nbr(n * 6);
+    const double dx = 1.0 / nx, dy = 1.0 / ny, dz = 1.0 / nz;
+    for (int64_t z = 0; z < nz; z++)
+    for (int64_t y = 0; y < ny; y++)
+    for (int64_t x = 0; x < nx; x++) {
+        const int64_t i = x + nx * (y + ny * z);
+        const double cx = (x + 0.5) * dx, cy = (y + 0.5) * dy,
+                     cz = (z + 0.5) * dz;
+        const double r2 = pow(cx - 0.5, 2) + pow(cy - 0.5, 2)
+                        + pow(cz - 0.5, 2);
+        for (int64_t b = 0; b < B; b++)
+            f[i * B + b] = exp(-20.0 * r2) * (1.0 + 0.1 * (b % 7));
+        nbr[i * 6 + 0] = ((x + nx - 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 1] = ((x + 1) % nx) + nx * (y + ny * z);
+        nbr[i * 6 + 2] = x + nx * (((y + ny - 1) % ny) + ny * z);
+        nbr[i * 6 + 3] = x + nx * (((y + 1) % ny) + ny * z);
+        nbr[i * 6 + 4] = x + nx * (y + ny * ((z + nz - 1) % nz));
+        nbr[i * 6 + 5] = x + nx * (y + ny * ((z + 1) % nz));
+    }
+
+    const double inv_d[3] = {1.0 / dx, 1.0 / dy, 1.0 / dz};
+    const double dmin = dx < dy ? (dx < dz ? dx : dz) : (dy < dz ? dy : dz);
+    const double dt = 0.4 * dmin / v_max;
+
+    const auto t0 = std::chrono::high_resolution_clock::now();
+    for (int64_t s = 0; s < steps; s++) {
+        for (int axis = 0; axis < 3; axis++) {
+#pragma omp parallel for schedule(static)
+            for (int64_t i = 0; i < n; i++) {
+                const double* fc = &f[i * B];
+                const double* fl = &f[nbr[i * 6 + axis * 2] * B];
+                const double* fh = &f[nbr[i * 6 + axis * 2 + 1] * B];
+                double* out = &g[i * B];
+                for (int64_t b = 0; b < B; b++) {
+                    const double v = vbin[b * 3 + axis];
+                    const double flux_hi = (v >= 0 ? fc[b] : fh[b]) * v;
+                    const double flux_lo = (v >= 0 ? fl[b] : fc[b]) * v;
+                    out[b] = fc[b] - dt * inv_d[axis] * (flux_hi - flux_lo);
+                }
+            }
+            f.swap(g);
+        }
+    }
+    const auto t1 = std::chrono::high_resolution_clock::now();
+    const double secs = std::chrono::duration<double>(t1 - t0).count();
+    volatile double sink = f[(n / 2) * B];
+    (void)sink;
+    printf("%.6e\n", double(n) * double(B) * steps / secs);
+    return 0;
+}
