@@ -38,6 +38,7 @@ __all__ = [
     "all_gather",
     "all_reduce",
     "some_reduce",
+    "some_reduce_p2p",
     "halo_peers",
 ]
 
@@ -220,11 +221,281 @@ def halo_peers(grid, device: int, hood_id=None) -> np.ndarray:
     return np.flatnonzero((pc[device] > 0) | (pc[:, device] > 0))
 
 
+class _P2PTransport:
+    """Point-to-point controller transport — the role of the reference's
+    ``MPI_Isend``/``MPI_Irecv`` pairs in ``Some_Reduce``
+    (``dccrg_mpi_support.hpp:282-377``): per exchange, a message travels
+    to and from EACH neighbor process individually; no process outside
+    the neighbor set takes part and no collective runs.
+
+    Bootstrap is one global collective (the address book gathers every
+    process's (ip, port) via the allgather seam — the ``MPI_Init`` of
+    this layer); after that, exchanges open fresh TCP connections only
+    between the participating pairs.  Deadlock-free by orientation: the
+    lower rank of each pair connects, the higher rank accepts, and an
+    initiator reads its response before its call returns, which
+    serializes each pair's exchanges (the per-pair sequence number in
+    the header asserts it).  Byte counts per peer are recorded in
+    ``sent_to``/``received_from`` so tests can check the transport
+    really is neighbor-only."""
+
+    _instance = None
+
+    @classmethod
+    def get(cls) -> "_P2PTransport":
+        """The per-process singleton.  FIRST call is a global collective
+        (every process must reach it) — ``some_reduce`` guarantees this
+        because every controller calls it; direct ``some_reduce_p2p``
+        users must uphold it on first use."""
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        import socket
+        import struct
+
+        import jax
+
+        self.rank = jax.process_index()
+        self.sent_to: dict[int, int] = {}
+        self.received_from: dict[int, int] = {}
+        self._pair_seq: dict[int, int] = {}
+        #: connections accepted from peers that are ahead of us (already
+        #: in a later exchange whose peer set includes us while ours for
+        #: the current exchange does not) — consumed when we get there
+        self._pending: dict[int, tuple[int, bytes, object]] = {}
+        self._listener = socket.socket()
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(128)
+        port = self._listener.getsockname()[1]
+        ip_u32 = struct.unpack("!I", socket.inet_aton(self._advertised_ip()))[0]
+        book = _process_allgather(np.asarray([ip_u32, port], dtype=np.uint64))
+        self.addrs = [
+            (socket.inet_ntoa(struct.pack("!I", int(row[0]))), int(row[1]))
+            for row in np.atleast_2d(book)
+        ]
+
+    @staticmethod
+    def _advertised_ip() -> str:
+        """The address peers should dial: the interface that routes to
+        the jax coordinator (a UDP connect learns the outbound interface
+        without sending a packet) — gethostbyname commonly resolves to
+        127.0.0.1, which other HOSTS cannot dial.  ``DCCRG_P2P_HOST``
+        overrides for unusual network topologies."""
+        import os
+        import socket
+
+        override = os.environ.get("DCCRG_P2P_HOST")
+        if override:
+            return socket.gethostbyname(override)
+        try:
+            from jax._src.distributed import global_state
+
+            coord = global_state.coordinator_address
+            host, port = coord.rsplit(":", 1)
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((host, int(port)))
+                return s.getsockname()[0]
+        except Exception:  # noqa: BLE001 - fall back to name resolution
+            pass
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    _HEADER = "!III"          # sender rank, per-pair sequence, payload bytes
+
+    @staticmethod
+    def _recvn(sock, n: int) -> bytes:
+        chunks = []
+        while n:
+            b = sock.recv(n)
+            if not b:
+                raise ConnectionError("p2p peer closed mid-message")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def exchange(self, payload: bytes, peers) -> dict[int, bytes]:
+        """Symmetric send+receive of ``payload`` with every process in
+        ``peers`` (collective among exactly those processes + self).
+        Returns {peer: its payload}.
+
+        Every send runs in its own thread (the reference's ``MPI_Isend``
+        posture): the main thread only reads, so no send-blocking cycle
+        can form in a fully-connected clique regardless of payload size
+        vs kernel socket buffers.  A connection arriving from a peer
+        that is already in a LATER exchange (one whose peer set includes
+        us while our current one does not include it) is stashed and
+        consumed when we reach that exchange; the peer simply blocks in
+        its read until then, which is ordinary collective alignment."""
+        import socket
+        import struct
+        import threading
+
+        peers = sorted({int(p) for p in peers} - {self.rank})
+        out: dict[int, bytes] = {}
+        conns = []
+        senders = []
+        errors = []
+
+        def send_all(sock, data):
+            try:
+                sock.sendall(data)
+            except OSError as e:  # surfaced after the joins below
+                errors.append(e)
+
+        def spawn_send(sock, data):
+            t = threading.Thread(target=send_all, args=(sock, data),
+                                 daemon=True)
+            t.start()
+            senders.append(t)
+
+        hdr_n = struct.calcsize(self._HEADER)
+        # initiate toward higher ranks (lower rank of each pair connects)
+        for p in (q for q in peers if q > self.rank):
+            seq = self._pair_seq[p] = self._pair_seq.get(p, 0) + 1
+            s = socket.create_connection(self.addrs[p], timeout=120)
+            s.settimeout(120)
+            spawn_send(s, struct.pack(self._HEADER, self.rank, seq,
+                                      len(payload)) + payload)
+            conns.append((p, seq, s))
+            self.sent_to[p] = self.sent_to.get(p, 0) + len(payload)
+
+        def serve_lower(rk, seq, body, conn):
+            my_seq = self._pair_seq[rk] = self._pair_seq.get(rk, 0) + 1
+            if seq != my_seq:
+                raise RuntimeError(
+                    f"p2p exchange out of step with process {rk} "
+                    f"(seq {seq} != {my_seq})"
+                )
+            out[rk] = body
+            spawn_send(conn, struct.pack(self._HEADER, self.rank, my_seq,
+                                         len(payload)) + payload)
+            self.received_from[rk] = self.received_from.get(rk, 0) + len(body)
+            self.sent_to[rk] = self.sent_to.get(rk, 0) + len(payload)
+
+        # accept from lower ranks (stashed connections first)
+        expect = {q for q in peers if q < self.rank}
+        served = []
+        for rk in sorted(expect & set(self._pending)):
+            seq, body, conn = self._pending.pop(rk)
+            serve_lower(rk, seq, body, conn)
+            served.append(conn)
+            expect.discard(rk)
+        self._listener.settimeout(120)
+        while expect:
+            c, _ = self._listener.accept()
+            c.settimeout(120)
+            rk, seq, nbytes = struct.unpack(
+                self._HEADER, self._recvn(c, hdr_n)
+            )
+            body = self._recvn(c, nbytes)
+            if rk not in expect:
+                # a peer already in a later exchange that includes us —
+                # hold its message until we reach that exchange
+                if rk in self._pending:
+                    c.close()
+                    raise RuntimeError(
+                        f"two pending p2p exchanges from process {rk}"
+                    )
+                self._pending[rk] = (seq, body, c)
+                continue
+            serve_lower(rk, seq, body, c)
+            served.append(c)
+            expect.discard(rk)
+        # collect responses from higher ranks
+        for p, seq, s in conns:
+            rk, r_seq, nbytes = struct.unpack(
+                self._HEADER, self._recvn(s, hdr_n)
+            )
+            if rk != p or r_seq != seq:
+                raise RuntimeError(
+                    f"p2p response out of step from process {p}"
+                )
+            out[p] = self._recvn(s, nbytes)
+            self.received_from[p] = self.received_from.get(p, 0) + nbytes
+        for t in senders:
+            t.join(timeout=120)
+        for s in served + [s for _, _, s in conns]:
+            s.close()
+        if errors:
+            raise errors[0]
+        return out
+
+
+def some_reduce_p2p(value, neighbor_processes, op=np.add):
+    """The reference's ``Some_Reduce`` at process level
+    (``dccrg_mpi_support.hpp:282-377``): symmetric point-to-point
+    exchange of ``value`` with each process in ``neighbor_processes``,
+    returning ``op`` over own + received values.  Collective among
+    exactly those processes (each must name the others); identity with
+    one controller or an empty neighbor set.  Like the reference, each
+    process may pass a different value and neighbor set and gets its own
+    neighborhood's result."""
+    arr = np.ascontiguousarray(value)
+    peers = sorted({int(p) for p in neighbor_processes})
+    if process_count() == 1 or not peers:
+        return arr if arr.shape else arr[()]
+    t = _P2PTransport.get()
+    got = t.exchange(arr.tobytes(), peers)
+    stack = [arr] + [
+        np.frombuffer(got[p], dtype=arr.dtype).reshape(arr.shape)
+        for p in sorted(got)
+    ]
+    return op.reduce(np.stack(stack), axis=0)
+
+
 def some_reduce(grid, per_device_values, device: int, op=np.add, hood_id=None):
     """Reduce only among a device and its halo peers — the reference's
     neighbor-only point-to-point reduce (``Some_Reduce``), whose peer set
-    here comes from the halo schedule instead of explicit rank lists."""
+    here comes from the halo schedule instead of explicit rank lists.
+
+    Under multi-controller, each member process's OWN devices'
+    contributions travel point-to-point among exactly the processes
+    owning member devices — transport parity with the reference, not
+    just value parity.  Every controller (member or not) assembles the
+    full member value list and reduces it in ascending DEVICE order, so
+    float results are bitwise identical everywhere (a per-process
+    partial-then-merge would associate differently on each controller).
+    A controller owning no member device computes from its replicated
+    metadata view (per-device metadata is replicated by design) without
+    joining the exchange."""
     peers = halo_peers(grid, device, hood_id)
     vals = np.asarray(per_device_values)
-    members = np.unique(np.concatenate([[device], peers]))
-    return op.reduce(vals[members], axis=0)
+    members = np.unique(np.concatenate([[device], peers])).astype(np.int64)
+    if process_count() == 1:
+        return op.reduce(vals[members], axis=0)
+    import jax
+
+    # EVERY controller reaches the transport bootstrap (a global
+    # collective on first use) before any neighbor-only exchange
+    transport = _P2PTransport.get()
+    me = jax.process_index()
+    owner_proc = np.asarray([
+        grid.mesh.devices.flat[int(d)].process_index for d in members
+    ])
+    mine = members[owner_proc == me]
+    member_procs = sorted({int(p) for p in owner_proc} - {me})
+    if not len(mine) or not member_procs:
+        return op.reduce(vals[members], axis=0)
+    # ship (member device ids, values) so peers can slot contributions
+    # into the canonical ascending-device order
+    payload = (np.uint64(len(mine)).tobytes()
+               + mine.astype(np.int64).tobytes()
+               + np.ascontiguousarray(vals[mine]).tobytes())
+    got = transport.exchange(payload, member_procs)
+    by_device = {int(d): vals[int(d)] for d in mine}
+    item = vals[members[0]]
+    for body in got.values():
+        k = int(np.frombuffer(body[:8], np.uint64)[0])
+        devs = np.frombuffer(body[8:8 + 8 * k], np.int64)
+        peer_vals = np.frombuffer(
+            body[8 + 8 * k:], dtype=item.dtype
+        ).reshape((k,) + item.shape)
+        for d, v in zip(devs, peer_vals):
+            by_device[int(d)] = v
+    assert len(by_device) == len(members), "missing member contributions"
+    ordered = np.stack([by_device[int(d)] for d in sorted(by_device)])
+    return op.reduce(ordered, axis=0)

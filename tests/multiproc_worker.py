@@ -198,6 +198,65 @@ def main() -> None:
         "solution": [float(v) for v in sol],
     }
 
+    # ---- scenario 7: point-to-point Some_Reduce ----------------------
+    # (reference dccrg_mpi_support.hpp:282-377: Isend/Irecv value
+    # exchange among an explicit neighbor-process set — transport
+    # parity, not just value parity)
+    from dccrg_tpu.utils.collectives import (
+        _P2PTransport, some_reduce, some_reduce_p2p,
+    )
+
+    # bootstrap is a global collective (the address-book allgather) —
+    # reach it on every process before any neighbor-only exchange
+    transport = _P2PTransport.get()
+
+    # a strict PAIR exchange: processes 0 and 1 exchange; everyone else
+    # stays out entirely — the transport must touch only the named peer
+    pair_peer = {0: 1, 1: 0}.get(pid)
+    if pair_peer is not None:
+        v = some_reduce_p2p(np.uint64(5 + pid), [pair_peer])
+        assert int(v) == (5 + pid) + (5 + pair_peer), v
+        assert set(transport.sent_to) == {pair_peer}, transport.sent_to
+        assert set(transport.received_from) == {pair_peer}
+    else:
+        v = some_reduce_p2p(np.uint64(7), [])     # empty set: identity
+        assert int(v) == 7
+        assert not transport.sent_to and not transport.received_from
+
+    # the reference's symmetric clique: every process exchanges with all
+    # others; each gets the full sum
+    full = some_reduce_p2p(np.uint64(10 ** pid),
+                           [p for p in range(nproc) if p != pid])
+    assert int(full) == sum(10 ** p for p in range(nproc)), full
+
+    # mismatched peer sets across consecutive exchanges: 1 and 2 run a
+    # pair while 0 skips straight to the next clique — 0's early connect
+    # must be stashed by the acceptor, not rejected (nproc >= 3 only)
+    if nproc >= 3:
+        if pid in (1, 2):
+            v = some_reduce_p2p(np.uint64(pid), [3 - pid])
+            assert int(v) == 3, v
+        skew = some_reduce_p2p(np.uint64(pid),
+                               [p for p in range(nproc) if p != pid])
+        assert int(skew) == sum(range(nproc)), skew
+
+    # payload far beyond kernel socket buffers: the threaded sends keep
+    # a fully-connected clique deadlock-free
+    big = np.full(200_000, float(pid + 1), np.float64)   # 1.6 MB
+    big_sum = some_reduce_p2p(big, [p for p in range(nproc) if p != pid])
+    assert big_sum.shape == big.shape
+    assert float(big_sum[0]) == sum(range(1, nproc + 1))
+    assert np.all(big_sum == big_sum[0])
+
+    # device-level Some_Reduce on the gol grid: member processes carry
+    # partials over the wire, the rest compute from replicated metadata
+    n_dev = len(jax.devices())
+    counts = np.asarray(
+        [grid.get_local_cell_count(d) for d in range(n_dev)], np.uint64
+    )
+    sr = some_reduce(grid, counts, 0)
+    res["some_reduce"] = {"device0": int(sr), "clique": int(full)}
+
     print("RESULT " + json.dumps(res), flush=True)
 
 

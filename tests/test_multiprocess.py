@@ -186,3 +186,31 @@ def test_flat_poisson_across_controllers(multi_proc_results):
     np.testing.assert_allclose(np.asarray(res["solution"]), sol,
                                rtol=1e-7, atol=1e-10)
     assert res["residual"] == pytest.approx(r, rel=1e-6)
+
+
+def test_some_reduce_point_to_point(multi_proc_results):
+    """The point-to-point Some_Reduce (reference
+    dccrg_mpi_support.hpp:282-377): the clique exchange sums every
+    process's value, and the device-level reduce over device 0's halo
+    peer group matches a single-process oracle.  The workers themselves
+    assert the transport touched ONLY the named peers."""
+    res = multi_proc_results[0]
+    D = res["n_devices"]
+    nproc = res["nproc"]
+    assert res["some_reduce"]["clique"] == sum(10 ** p for p in range(nproc))
+
+    from dccrg_tpu import Grid, make_mesh
+    from dccrg_tpu.utils.collectives import some_reduce
+
+    grid = (
+        Grid()
+        .set_initial_length((10, 10, 1))
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .set_load_balancing_method("RCB")
+        .initialize(mesh=make_mesh(n_devices=D))
+    )
+    counts = np.asarray(
+        [grid.get_local_cell_count(d) for d in range(D)], np.uint64
+    )
+    assert res["some_reduce"]["device0"] == int(some_reduce(grid, counts, 0))
