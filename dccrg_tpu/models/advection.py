@@ -401,7 +401,7 @@ class Advection:
             flux_update_fits,
             fused_run_fits,
             make_flux_update,
-            make_flux_update_blocked,
+            make_flux_update_blocked_direct,
             make_fused_run,
             pallas_available,
             pick_step_block,
@@ -410,6 +410,10 @@ class Advection:
         pallas_update = None
         blocked_update = None
         step_block = 0
+        #: which per-step dense kernel engaged — ("blocked_direct", B) /
+        #: ("plane",) / ("xla",) — so the bench's HBM-traffic model can
+        #: count the bytes the engaged path actually moves
+        self.dense_kind = ("xla",)
         use_pallas = getattr(self, "use_pallas", True)
         # use_pallas="interpret" forces the kernels through the Pallas
         # interpreter so CI (CPU) exercises the full integration path
@@ -417,19 +421,20 @@ class Advection:
         if use_pallas and (interpret or pallas_available(dtype)):
             step_block = pick_step_block(nzl, ny, nx)
             if step_block >= 2:
-                blocked_update = make_flux_update_blocked(
+                blocked_update = make_flux_update_blocked_direct(
                     nzl, ny, nx, step_block, area, 1.0 / vol,
                     interpret=interpret,
                 )
+                self.dense_kind = ("blocked_direct", step_block)
             elif interpret or flux_update_fits(ny, nx):
                 pallas_update = make_flux_update(
                     nzl, ny, nx, area, 1.0 / vol, interpret=interpret
                 )
+                self.dense_kind = ("plane",)
             if blocked_update is not None or pallas_update is not None:
                 mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
                 my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
 
-        halo_stacks = extend.block_stacks
 
         # Negative-side x/y faces: the flux through cell i's negative face
         # equals the positive-side face flux of cell i-1, i.e.
@@ -438,10 +443,13 @@ class Advection:
         # x+, y+, z+); negative-side face flux enters the cell with +,
         # positive-side leaves with - (solve.hpp:227-233).
         def blocked_step(rho, vx, vy, vz, v_lo, v_hi, mzu, mzd, dt):
-            """One blocked-kernel step given prebuilt vz halo stacks —
-            shared by step() (stacks rebuilt per call: vz is an input) and
-            the multi-step run (stacks hoisted out of the loop)."""
-            r_lo, r_hi = halo_stacks(rho, step_block)
+            """One blocked-kernel step given the vz device-edge planes —
+            shared by step() (planes rebuilt per call: vz is an input)
+            and the multi-step run (planes hoisted out of the loop).
+            rho's interior neighbor planes are read in-kernel through the
+            direct index maps; only its two ppermute edge planes are
+            produced here."""
+            r_lo, r_hi = extend.planes(rho)
             return blocked_update(
                 rho, r_lo, r_hi, vx, vy, vz, v_lo, v_hi, mx3, my3,
                 mzu, mzd, dt,
@@ -453,7 +461,7 @@ class Advection:
             mz_dn = zf_dn[0][:, None, None]
 
             if blocked_update is not None:
-                v_lo, v_hi = halo_stacks(vz, step_block)
+                v_lo, v_hi = extend.planes(vz)
                 new_rho = blocked_step(
                     rho, vx, vy, vz, v_lo, v_hi, mz_up, mz_dn, dt
                 )
@@ -533,7 +541,7 @@ class Advection:
                 rho, vx, vy, vz = rho[0], vx[0], vy[0], vz[0]
                 mzu = zf_up[0][:, None, None]
                 mzd = zf_dn[0][:, None, None]
-                v_lo, v_hi = halo_stacks(vz, step_block)
+                v_lo, v_hi = extend.planes(vz)
 
                 def one(i, r):
                     return blocked_step(

@@ -28,7 +28,7 @@ except Exception:  # pragma: no cover
 __all__ = [
     "pallas_available",
     "make_flux_update",
-    "make_flux_update_blocked",
+    "make_flux_update_blocked_direct",
     "pick_step_block",
     "make_fused_run",
     "fused_run_fits",
@@ -181,50 +181,64 @@ def pick_step_block(nzl: int, ny: int, nx: int) -> int:
     """Largest z-block size B (a divisor of nzl, >=2) whose blocked-kernel
     VMEM residency fits the raised scoped budget; 0 if none does.
 
-    Residency model: the 5 input + 1 output center blocks double-buffered
-    (12B planes) plus ~8B planes of kernel temporaries plus the 12
-    single-plane halo/DMA buffers — ~(20B + 12) plane-sized arrays.
-    Larger B amortizes the halo re-reads: HBM traffic per step is
-    ~(5 + 4/B) full arrays instead of the plane kernel's ~13 (which
+    Residency model (the direct-neighbor-plane kernel,
+    ``make_flux_update_blocked_direct``): the 4 input + 1 output center
+    blocks double-buffered (10B planes) plus ~6B planes of kernel
+    temporaries plus the 8 single-plane neighbor/edge inputs
+    double-buffered (16 planes) — ~(16B + 16) plane-sized arrays.
+    Larger B amortizes the neighbor-plane re-reads: HBM traffic per step
+    is ~(5 + 4/B) full arrays instead of the plane kernel's ~13 (which
     re-reads the +-1 z views of rho and vz three times each and
     re-materializes both halo-extended copies every step)."""
     plane = ny * nx * 4
     for b in (16, 8, 4, 2):
-        if nzl % b == 0 and (20 * b + 12) * plane <= _STEP_VMEM_BUDGET:
+        if nzl % b == 0 and (16 * b + 16) * plane <= _STEP_VMEM_BUDGET:
             return b
     return 0
 
 
-def make_flux_update_blocked(nzl: int, ny: int, nx: int, block: int, area,
-                             inv_vol: float, *, interpret: bool = False):
-    """Blocked per-step kernel: ``update(rho, rho_lo, rho_hi, vx, vy, vz,
-    vz_lo, vz_hi, mx, my, mz_up, mz_dn, dt) -> new_rho``.
+def make_flux_update_blocked_direct(nzl: int, ny: int, nx: int, block: int,
+                                    area, inv_vol: float, *,
+                                    interpret: bool = False):
+    """Blocked per-step kernel with DIRECT z-neighbor plane reads:
+    ``update(rho, edge_lo, edge_hi, vx, vy, vz, vz_edge_lo, vz_edge_hi,
+    mx, my, mz_up, mz_dn, dt) -> new_rho``.
 
-    Each program handles a ``block``-plane z-slab; z-neighbor values are
-    in-VMEM rolls with the block-edge planes spliced in from the per-block
-    halo stacks ``*_lo``/``*_hi`` (shape ``[nzl/block, ny, nx]``: row k
-    holds the plane below/above block k — built host-side from strided
-    slices plus the ppermute-received device-boundary planes).  Unlike
-    make_flux_update there is no halo-extended array: rho is read ~once
-    per step instead of three times, and nothing is concatenated in HBM."""
+    Rather than consuming per-block halo stacks a host-side slice pass
+    must rebuild from rho EVERY step (read 2/B + write 2/B
+    arrays-worth, then read them again in-kernel — the retired stacked
+    variant's cost), this kernel reads the block-edge neighbor planes
+    straight out of ``rho`` through shifted plane-shaped block index
+    maps — block k's low/high
+    neighbor planes are rho planes ``k*B-1`` / ``(k+1)*B`` (mod nzl).
+    Only the two ppermute-received device-boundary planes remain inputs,
+    spliced at programs 0 and m-1.  Per-step HBM traffic drops from
+    ``5 + 8/B`` to ``5 + 4/B`` full arrays."""
     assert nzl % block == 0 and block >= 2
     m = nzl // block
     area_x, area_y, area_z = (float(a) for a in area)
     inv_vol = float(inv_vol)
     roll_m1, roll_p1 = _make_rolls(interpret)
 
-    def kernel(dt_ref, r_c, r_lo, r_hi, vx, vy, vz_c, vz_lo, vz_hi,
+    def kernel(dt_ref, r_c, r_lop, r_hip, e_lo, e_hi, vx, vy,
+               vz_c, vz_lop, vz_hip, ve_lo, ve_hi,
                mx, my, mzu, mzd, out):
         dt = dt_ref[0]
+        k = pl.program_id(0)
         r = r_c[...]
         zidx = jax.lax.broadcasted_iota(jnp.int32, (block, ny, nx), 0)
-        # plane j's z-neighbors: j+-1 within the block, halo stacks at the
-        # block edges (the roll wraps there, so the splice overwrites it)
-        r_up = jnp.where(zidx == block - 1, r_hi[...], roll_m1(r, 0))
-        r_dn = jnp.where(zidx == 0, r_lo[...], roll_p1(r, 0))
+        # block-edge neighbor planes: direct reads of the adjacent rho
+        # planes, except at the device boundary where the ppermute
+        # plane substitutes (for one device it equals the wrap)
+        lo_plane = jnp.where(k == 0, e_lo[...], r_lop[...])
+        hi_plane = jnp.where(k == m - 1, e_hi[...], r_hip[...])
+        r_up = jnp.where(zidx == block - 1, hi_plane, roll_m1(r, 0))
+        r_dn = jnp.where(zidx == 0, lo_plane, roll_p1(r, 0))
         vz = vz_c[...]
-        vz_up = jnp.where(zidx == block - 1, vz_hi[...], roll_m1(vz, 0))
-        vz_dn = jnp.where(zidx == 0, vz_lo[...], roll_p1(vz, 0))
+        v_lo_plane = jnp.where(k == 0, ve_lo[...], vz_lop[...])
+        v_hi_plane = jnp.where(k == m - 1, ve_hi[...], vz_hip[...])
+        vz_up = jnp.where(zidx == block - 1, v_hi_plane, roll_m1(vz, 0))
+        vz_dn = jnp.where(zidx == 0, v_lo_plane, roll_p1(vz, 0))
 
         rxp = roll_m1(r, 2)
         vfx = (vx[...] + roll_m1(vx[...], 2)) * 0.5
@@ -255,8 +269,16 @@ def make_flux_update_blocked(nzl: int, ny: int, nx: int, block: int, area,
     cspec = pl.BlockSpec(
         (block, ny, nx), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM
     )
-    hspec = pl.BlockSpec(
-        (1, ny, nx), lambda k, *_: (k, 0, 0), memory_space=pltpu.VMEM
+    lospec = pl.BlockSpec(
+        (1, ny, nx), lambda k, *_: ((k * block - 1) % nzl, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    hispec = pl.BlockSpec(
+        (1, ny, nx), lambda k, *_: (((k + 1) * block) % nzl, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    espec = pl.BlockSpec(
+        (1, ny, nx), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM
     )
     mxspec = pl.BlockSpec((1, 1, nx), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM)
     myspec = pl.BlockSpec((1, ny, 1), lambda k, *_: (0, 0, 0), memory_space=pltpu.VMEM)
@@ -273,9 +295,9 @@ def make_flux_update_blocked(nzl: int, ny: int, nx: int, block: int, area,
             num_scalar_prefetch=1,
             grid=(m,),
             in_specs=[
-                cspec, hspec, hspec,           # rho + halo stacks
-                cspec, cspec,                  # vx, vy
-                cspec, hspec, hspec,           # vz + halo stacks
+                cspec, lospec, hispec, espec, espec,   # rho + neighbor planes
+                cspec, cspec,                          # vx, vy
+                cspec, lospec, hispec, espec, espec,   # vz + neighbor planes
                 mxspec, myspec, mzspec, mzspec,
             ],
             out_specs=cspec,
@@ -285,10 +307,11 @@ def make_flux_update_blocked(nzl: int, ny: int, nx: int, block: int, area,
         **kwargs,
     )
 
-    def update(rho, rho_lo, rho_hi, vx, vy, vz, vz_lo, vz_hi,
+    def update(rho, edge_lo, edge_hi, vx, vy, vz, vz_edge_lo, vz_edge_hi,
                mx, my, mz_up, mz_dn, dt):
         dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
-        return call(dt_arr, rho, rho_lo, rho_hi, vx, vy, vz, vz_lo, vz_hi,
+        return call(dt_arr, rho, rho, rho, edge_lo, edge_hi, vx, vy,
+                    vz, vz, vz, vz_edge_lo, vz_edge_hi,
                     mx, my, mz_up, mz_dn)
 
     return update

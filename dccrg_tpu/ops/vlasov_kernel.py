@@ -6,7 +6,7 @@ distribution after the x and the y split — at Vlasiator-scale payloads
 (B = nv^3 f32 per spatial cell) every materialization is a full HBM
 round trip, and the step runs ~3x the unavoidable traffic.  This kernel
 tiles the spatial z axis into blocks like
-``dense_advection.make_flux_update_blocked``: each program reads its
+the blocked advection kernel (``dense_advection``): each program reads its
 ``block`` z planes of f plus the two adjacent halo planes, recomputes
 the (plane-local) x/y splits on the halo planes in VMEM, and splices
 them into the z split — so f is read ~(1 + 2/block) times and written

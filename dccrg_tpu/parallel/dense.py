@@ -135,8 +135,9 @@ class HaloExtend:
         """Per-block leading-axis halo stacks for blocked kernels: row k
         of ``(lo, hi)`` holds the plane below/above block k — interior
         rows are strided slices of ``blk``, the edge rows the
-        ppermute-received device-boundary planes.  Shared by the blocked
-        advection and Vlasov kernels so the indexing cannot diverge."""
+        ppermute-received device-boundary planes.  Used by the blocked
+        Vlasov kernel (the advection kernel reads its neighbor planes
+        directly through shifted block index maps instead)."""
         below, above = self.planes(blk)
         if blk.shape[0] == block:
             return below, above

@@ -105,6 +105,16 @@ MEASUREMENTS = {
     "pic": ("import bench\nprint(json.dumps(bench.measure_pic()))", 1500),
     "poisson": ("import bench\nprint(json.dumps(bench.measure_poisson()))",
                 1500),
+    # the general gather-table path on the SAME refined config, for the
+    # VERDICT-r3 attribution of its 0.13x showing (bench.measure_poisson
+    # stays the single source of truth for the configuration)
+    "poisson_gather": ("""
+import bench
+out = bench.measure_poisson(allow_flat=False, use_pallas=False,
+                            include_uniform=False)
+out["device_kind"] = jax.devices()[0].device_kind
+print(json.dumps(out))
+""", 1500),
     "vlasov": ("import bench\nprint(json.dumps(bench.measure_vlasov()))",
                1500),
     "flat_kernel_sweep_Bvox_per_s": ("""
