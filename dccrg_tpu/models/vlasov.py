@@ -141,16 +141,13 @@ class Vlasov:
 
             def body_fast(f, dt):
                 f = f[0]
-                lo, hi = extend.block_stacks(f, blk)
+                lo, hi = extend.planes(f)
                 if not periodic[2]:
-                    # open z: the wrap-received edge planes are vacuum —
-                    # lo's first row (below block 0) on device 0, hi's
-                    # last row (above the last block) on device D-1
+                    # open z: the wrap-received device-edge planes are
+                    # vacuum — below device 0, above device D-1
                     d = jax.lax.axis_index(SHARD_AXIS)
-                    lo = lo.at[0].multiply(
-                        jnp.where(d == 0, 0, 1).astype(dtype))
-                    hi = hi.at[-1].multiply(
-                        jnp.where(d == D - 1, 0, 1).astype(dtype))
+                    lo = lo * jnp.where(d == 0, 0, 1).astype(dtype)
+                    hi = hi * jnp.where(d == D - 1, 0, 1).astype(dtype)
                 return (kern(f, lo, hi, vxb, vyb, vzb, dt)[None],)
 
         def make_pair(b):

@@ -33,9 +33,9 @@ except Exception:  # pragma: no cover
 
 __all__ = ["make_vlasov_step_blocked", "pick_vlasov_block"]
 
-#: scoped-VMEM cap (v5e ~128 MB): per program ~(7*block + 8) plane-sized
+#: scoped-VMEM cap (v5e ~128 MB): per program ~(7*block + 10) plane-sized
 #: arrays (double-buffered center in/out, the xy-split recompute of the
-#: block + 2 halo planes, and step temporaries)
+#: block + the 4 neighbor/edge planes, and step temporaries)
 _VLASOV_VMEM_BUDGET = 100 * 1024 * 1024
 
 
@@ -44,7 +44,7 @@ def pick_vlasov_block(nzl: int, ny: int, nx: int, B: int) -> int:
     fits the scoped-VMEM budget; 0 if none does."""
     plane = ny * nx * B * 4
     for b in (8, 4, 2):
-        if nzl % b == 0 and (7 * b + 8) * plane <= _VLASOV_VMEM_BUDGET:
+        if nzl % b == 0 and (7 * b + 10) * plane <= _VLASOV_VMEM_BUDGET:
             return b
     return 0
 
@@ -52,22 +52,26 @@ def pick_vlasov_block(nzl: int, ny: int, nx: int, B: int) -> int:
 def make_vlasov_step_blocked(nzl: int, ny: int, nx: int, B: int, inv_dx,
                              periodic, *, block: int,
                              interpret: bool = False):
-    """Returns ``step(f, f_lo, f_hi, vx, vy, vz, dt) -> f'`` over one
-    device's ``[nzl, ny, nx, B]`` phase-space block.
+    """Returns ``step(f, edge_lo, edge_hi, vx, vy, vz, dt) -> f'`` over
+    one device's ``[nzl, ny, nx, B]`` phase-space block.
 
-    ``f_lo``/``f_hi``: ``[nzl/block, ny, nx, B]`` halo stacks — row k
-    holds the f plane below/above block k (strided slices of f plus the
-    ppermuted device-boundary planes; open-z zeroing is the caller's,
-    exactly as the XLA body zeroes the extended array's end planes).
-    ``vx/vy/vz``: ``[1, 1, 1, B]`` per-bin velocities."""
+    Block-edge neighbor planes are read straight out of ``f`` through
+    shifted plane block index maps (planes ``k*block-1`` / ``(k+1)*block``
+    mod nzl); ``edge_lo``/``edge_hi`` are the two ppermute-received
+    device-boundary planes ``[1, ny, nx, B]``, spliced at programs 0 and
+    m-1 (open-z zeroing is the caller's, exactly as the XLA body zeroes
+    the extended array's end planes).  ``vx/vy/vz``: ``[1, 1, 1, B]``
+    per-bin velocities."""
     assert nzl % block == 0 and block >= 2
     m = nzl // block
     px, py = bool(periodic[0]), bool(periodic[1])
     inv_x, inv_y, inv_z = (float(v) for v in inv_dx)
     roll_m1, roll_p1 = _make_rolls(interpret)
 
-    def kernel(dt_ref, f_c, f_lo, f_hi, vx_ref, vy_ref, vz_ref, out):
+    def kernel(dt_ref, f_c, f_lop, f_hip, e_lo, e_hi,
+               vx_ref, vy_ref, vz_ref, out):
         dt = dt_ref[0]
+        k = pl.program_id(0)
         vx, vy, vz = vx_ref[...], vy_ref[...], vz_ref[...]
 
         def split(f, lo, hi, vd, inv_d):
@@ -93,8 +97,10 @@ def make_vlasov_step_blocked(nzl: int, ny: int, nx: int, B: int, inv_dx,
             return split(f, lo, hi, vy, inv_y)
 
         g = xy(f_c[...])
-        gl = xy(f_lo[...])          # [1, ny, nx, B] halo planes, re-split
-        gh = xy(f_hi[...])
+        # neighbor planes: direct reads of the adjacent f planes, except
+        # at the device boundary where the ppermute plane substitutes
+        gl = xy(jnp.where(k == 0, e_lo[...], f_lop[...]))
+        gh = xy(jnp.where(k == m - 1, e_hi[...], f_hip[...]))
         zi = jax.lax.broadcasted_iota(jnp.int32, (block, ny, nx, B), 0)
         g_up = jnp.where(zi == block - 1, gh, roll_m1(g, 0))
         g_dn = jnp.where(zi == 0, gl, roll_p1(g, 0))
@@ -104,8 +110,16 @@ def make_vlasov_step_blocked(nzl: int, ny: int, nx: int, B: int, inv_dx,
         (block, ny, nx, B), lambda k, *_: (k, 0, 0, 0),
         memory_space=pltpu.VMEM,
     )
-    hspec = pl.BlockSpec(
-        (1, ny, nx, B), lambda k, *_: (k, 0, 0, 0), memory_space=pltpu.VMEM
+    lospec = pl.BlockSpec(
+        (1, ny, nx, B), lambda k, *_: ((k * block - 1) % nzl, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    hispec = pl.BlockSpec(
+        (1, ny, nx, B), lambda k, *_: (((k + 1) * block) % nzl, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    espec = pl.BlockSpec(
+        (1, ny, nx, B), lambda k, *_: (0, 0, 0, 0), memory_space=pltpu.VMEM
     )
     vspec = pl.BlockSpec(
         (1, 1, 1, B), lambda k, *_: (0, 0, 0, 0), memory_space=pltpu.VMEM
@@ -120,7 +134,8 @@ def make_vlasov_step_blocked(nzl: int, ny: int, nx: int, B: int, inv_dx,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(m,),
-            in_specs=[cspec, hspec, hspec, vspec, vspec, vspec],
+            in_specs=[cspec, lospec, hispec, espec, espec,
+                      vspec, vspec, vspec],
             out_specs=cspec,
         ),
         out_shape=jax.ShapeDtypeStruct((nzl, ny, nx, B), jnp.float32),
@@ -128,8 +143,8 @@ def make_vlasov_step_blocked(nzl: int, ny: int, nx: int, B: int, inv_dx,
         **kwargs,
     )
 
-    def step(f, f_lo, f_hi, vx, vy, vz, dt):
+    def step(f, edge_lo, edge_hi, vx, vy, vz, dt):
         dt_arr = jnp.asarray(dt, jnp.float32).reshape(1)
-        return call(dt_arr, f, f_lo, f_hi, vx, vy, vz)
+        return call(dt_arr, f, f, f, edge_lo, edge_hi, vx, vy, vz)
 
     return step

@@ -130,17 +130,3 @@ class HaloExtend:
         recv_below = jax.lax.ppermute(top, SHARD_AXIS, self.up)
         recv_above = jax.lax.ppermute(bot, SHARD_AXIS, self.down)
         return recv_below, recv_above
-
-    def block_stacks(self, blk, block: int):
-        """Per-block leading-axis halo stacks for blocked kernels: row k
-        of ``(lo, hi)`` holds the plane below/above block k — interior
-        rows are strided slices of ``blk``, the edge rows the
-        ppermute-received device-boundary planes.  Used by the blocked
-        Vlasov kernel (the advection kernel reads its neighbor planes
-        directly through shifted block index maps instead)."""
-        below, above = self.planes(blk)
-        if blk.shape[0] == block:
-            return below, above
-        lo = jnp.concatenate([below, blk[block - 1:-1:block]], axis=0)
-        hi = jnp.concatenate([blk[block::block], above], axis=0)
-        return lo, hi
