@@ -139,9 +139,13 @@ def measure_tpu() -> dict:
     }
 
 
-def measure_refined() -> dict:
-    """Two-level AMR grid on the boxed per-level fast path — the
-    reference's actual use case (cell-by-cell adaptive refinement)."""
+def measure_refined(force: str | None = None) -> dict:
+    """Two-level AMR grid on the refined fast paths — the reference's
+    actual use case (cell-by-cell adaptive refinement).
+
+    ``force``: None lets the dispatch choose (the production config);
+    "boxed"/"flat" pin the path, so calibration (tools/recalibrate.py)
+    measures each side directly instead of inferring which one ran."""
     import jax
     import numpy as np
 
@@ -173,6 +177,8 @@ def measure_refined() -> dict:
 
     adv = Advection(g, dtype=np.float32, allow_dense=False)
     assert adv.boxed is not None, "boxed fast path must engage"
+    if force is not None:
+        adv._prefer_boxed = force == "boxed"
     state = adv.initialize_state()
     dt = np.float32(0.4 * adv.max_time_step(state))
     jax.block_until_ready(adv.run(state, 2, dt))
@@ -180,6 +186,11 @@ def measure_refined() -> dict:
     return {
         "n_cells": n_cells,
         "levels": sorted(adv.boxed.boxes),
+        "path": ("boxed" if getattr(adv, "_prefer_boxed", False)
+                 else "flat" if adv._flat_run is not None else "boxed"),
+        "boxed_vol": sum(int(np.prod(b.shape))
+                         for b in adv.boxed.boxes.values()),
+        "flat_n_vox": int(getattr(adv, "_flat_n_vox", 0)),
         "updates_per_s": n_cells * REFINED_STEPS / secs,
         "secs": secs,
         "times": [round(t, 4) for t in times],

@@ -33,6 +33,28 @@ from ..utils.fallback import fallback_call
 __all__ = ["Advection"]
 
 
+def _flat_boxed_edge() -> float:
+    """The flat-vs-boxed dispatch edge: prefer the boxed per-level
+    passes when ``flat_n_vox > edge * boxed_vol``.  Measured on chip and
+    written by ``tools/recalibrate.py --write``; default is the
+    r2-measured ~2x flat per-voxel advantage.  A missing, malformed, or
+    out-of-range file falls back to the default — a calibration artifact
+    must never break or silently pin the dispatch."""
+    import json
+    import math
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parents[2]
+            / "tools" / "dispatch_calibration.json")
+    try:
+        edge = float(json.loads(path.read_text())["flat_boxed_edge"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return 2.0
+    if not math.isfinite(edge) or not 0.5 <= edge <= 100.0:
+        return 2.0
+    return edge
+
+
 class Advection:
     #: the reference's 9-double cell (density, velocity, flux, max_diff;
     #: lengths live in the geometry tables instead of per-cell storage)
@@ -73,14 +95,15 @@ class Advection:
             # boxed layout (e.g. wrap-adjacent refinement is gated out of
             # slab-mode boxed but handled exactly by the flat rolls)
             self._flat_run = self._build_flat_run()
-            # cost-based choice when both fast paths qualify: measured
-            # on-chip (TPU v5e), the flat kernel retires ~2x the voxel
-            # updates/s of the boxed per-level passes, so its 8x-inflated
-            # voxel grid only wins while it stays under ~2x the summed
-            # boxed box volumes.  Only the compiled single-device Pallas
-            # branch is calibrated — interpret mode (tests) and the
-            # sharded XLA form keep the flat preference so the flat
-            # numerics stay exercised
+            # cost-based choice when both fast paths qualify: prefer
+            # boxed only when the flat kernel's voxel inflation exceeds
+            # its measured per-voxel rate advantage over the boxed
+            # passes.  The edge constant comes from the on-chip battery
+            # via ``tools/recalibrate.py --write`` (falling back to the
+            # r2-measured ~2x when no calibration file exists).  Only
+            # the compiled single-device Pallas branch is calibrated —
+            # interpret mode (tests) and the sharded XLA form keep the
+            # flat preference so the flat numerics stay exercised
             if (
                 self._flat_kind == "pallas"
                 and self._flat_run is not None
@@ -89,7 +112,8 @@ class Advection:
                 boxed_vol = sum(
                     int(np.prod(b.shape)) for b in self.boxed.boxes.values()
                 )
-                self._prefer_boxed = self._flat_n_vox > 2.0 * boxed_vol
+                edge = _flat_boxed_edge()
+                self._prefer_boxed = self._flat_n_vox > edge * boxed_vol
 
     # ------------------------------------------------------ static tables
 

@@ -102,6 +102,11 @@ MEASUREMENTS = {
     "gol": ("import bench\nprint(json.dumps(bench.measure_gol()))", 1500),
     "refined_dispatch": (
         "import bench\nprint(json.dumps(bench.measure_refined()))", 1500),
+    # the boxed path pinned, so recalibration measures it directly
+    # instead of inferring which path the dispatch ran
+    "refined_boxed": (
+        "import bench\n"
+        "print(json.dumps(bench.measure_refined(force='boxed')))", 1500),
     "pic": ("import bench\nprint(json.dumps(bench.measure_pic()))", 1500),
     "poisson": ("import bench\nprint(json.dumps(bench.measure_poisson()))",
                 1500),

@@ -3,15 +3,22 @@
 
 Run after ``tools/onchip_r3.py`` has produced ``tools/onchip_r3.json``:
 
-    python tools/recalibrate.py
+    python tools/recalibrate.py [--write]
 
 Prints the measured flat-kernel per-voxel rates (padded vs unpadded),
-the boxed path's per-voxel rate inferred from the refined dispatch
-measurement, and the recommended flat/boxed edge constant for
-``models/advection.py`` (``_prefer_boxed``: prefer boxed when
-``flat_n_vox > EDGE * boxed_vol``).  The constant is the measured ratio
-of the flat kernel's voxel-update rate to the boxed path's — with a
-0.8 safety factor so the dispatch only flips when the win is clear.
+the boxed path's per-voxel rate from the PINNED ``refined_boxed``
+measurement (never inferred from whichever path the production dispatch
+happened to pick — that inference self-invalidates once a written edge
+flips the dispatch), and the recommended flat/boxed edge constant
+(``_prefer_boxed``: prefer boxed when ``flat_n_vox > EDGE * boxed_vol``).
+The constant is the measured ratio of the flat kernel's voxel-update
+rate to the boxed path's, with a 0.8 safety factor so the dispatch only
+flips when the win is clear.
+
+``--write`` persists the constant to ``tools/dispatch_calibration.json``,
+which ``models/advection.py`` reads at dispatch time; it refuses to
+write when the needed measurements are missing or internally
+inconsistent.
 """
 import json
 import pathlib
@@ -19,12 +26,6 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 BATTERY = ROOT / "tools" / "onchip_r3.json"
-
-#: the refined bench grid's dispatch inputs (48^3 coarse, ball refined;
-#: computed from the grid build — see the session notes)
-REFINED_N_CELLS = 198008
-REFINED_BOXED_VOL = 292480
-REFINED_FLAT_VOX = 884736
 
 
 def main():
@@ -44,36 +45,51 @@ def main():
         print(f"  lane-padding speedup on the refined-bench shape: "
               f"{flat_padded / flat_unpadded:.2f}x")
 
-    ref = data.get("refined_dispatch") or {}
-    rate = ref.get("updates_per_s")
-    if rate:
-        n_cells = ref.get("n_cells", REFINED_N_CELLS)
-        if n_cells != REFINED_N_CELLS:
-            print(f"\nWARNING: measured n_cells {n_cells} != the hardcoded "
-                  f"dispatch inputs ({REFINED_N_CELLS}) — the boxed volume "
-                  f"and voxel ratio below are stale; recompute them for "
-                  f"the current bench config")
+    disp = data.get("refined_dispatch") or {}
+    if disp.get("updates_per_s"):
+        print(f"\nrefined dispatch (production choice: "
+              f"{disp.get('path', '?')}): "
+              f"{disp['updates_per_s']:.3e} cell-updates/s")
+
+    boxed = data.get("refined_boxed") or {}
+    rate = boxed.get("updates_per_s")
+    ok_to_write = False
+    if rate and boxed.get("path") == "boxed" and boxed.get("boxed_vol"):
+        n_cells = boxed["n_cells"]
         steps_per_s = rate / n_cells
-        print(f"\nrefined dispatch: {rate:.3e} cell-updates/s "
-              f"({steps_per_s:.0f} steps/s)")
-        # whichever path the dispatch picked retires its voxel volume
-        # at steps_per_s; infer the boxed per-voxel rate from it when
-        # boxed was picked (the current default at edge 2.0)
-        boxed_vox_rate = steps_per_s * REFINED_BOXED_VOL / 1e9
-        print(f"  implied boxed per-voxel rate (if boxed ran): "
-              f"{boxed_vox_rate:.2f} B voxel-updates/s")
-        if isinstance(flat_padded, (int, float)):
+        boxed_vox_rate = steps_per_s * boxed["boxed_vol"] / 1e9
+        print(f"\nrefined boxed (pinned): {rate:.3e} cell-updates/s "
+              f"-> {boxed_vox_rate:.2f} B voxel-updates/s")
+        if isinstance(flat_padded, (int, float)) and boxed_vox_rate > 0:
             edge = flat_padded / boxed_vox_rate
             rec = round(0.8 * edge, 1)
             print(f"\npadded-flat / boxed per-voxel edge: {edge:.2f}")
-            print(f"recommended _prefer_boxed constant "
-                  f"(models/advection.py, currently 2.0): {rec}")
-            ratio = REFINED_FLAT_VOX / REFINED_BOXED_VOL
-            print(f"refined-bench voxel ratio is {ratio:.2f} -> dispatch "
-                  f"{'FLIPS to flat' if rec > ratio else 'stays boxed'} "
-                  f"on that config with that constant")
+            print(f"recommended _prefer_boxed edge constant "
+                  f"(default 2.0): {rec}")
+            if boxed.get("flat_n_vox"):
+                ratio = boxed["flat_n_vox"] / boxed["boxed_vol"]
+                print(f"refined-bench voxel ratio is {ratio:.2f} -> "
+                      f"dispatch "
+                      f"{'FLIPS to flat' if rec > ratio else 'stays boxed'} "
+                      f"on that config with that constant")
+            ok_to_write = 0.5 <= rec <= 100.0
+            if "--write" in sys.argv:
+                if not ok_to_write:
+                    sys.exit(f"refusing to write out-of-range edge {rec}")
+                out = ROOT / "tools" / "dispatch_calibration.json"
+                out.write_text(json.dumps(
+                    {"flat_boxed_edge": rec,
+                     "source": "tools/recalibrate.py from onchip battery"},
+                    indent=1,
+                ))
+                print(f"wrote {out} — models/advection.py reads it at "
+                      "dispatch time")
     else:
-        print("\nno refined_dispatch measurement yet")
+        print("\nno pinned refined_boxed measurement yet — cannot "
+              "compute the edge (and will not infer it from the "
+              "production dispatch's path)")
+        if "--write" in sys.argv:
+            sys.exit("refusing to write without a refined_boxed record")
 
 
 if __name__ == "__main__":
