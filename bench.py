@@ -837,6 +837,27 @@ def _emit_fallback(diag):
                         "the tunnel (tools/onchip_r3.py --watch measures "
                         "incrementally whenever it comes up)",
             },
+            "round4_changes_unmeasured_on_chip": {
+                "advection_blocked_direct": "per-step streaming traffic "
+                    "5+8/B -> 5+4/B full arrays (B=4 on the large grid: "
+                    "7 -> 6 passes, expected ~14% step-time cut if "
+                    "HBM-bound)",
+                "vlasov_direct_planes": "per-step halo-stack rebuild "
+                    "removed: ~5 -> ~3 passes of the phase-space array "
+                    "at block=2 (expected up to ~1.6x step-time cut if "
+                    "HBM-bound)",
+                "poisson_default_path": "measure_poisson now runs the "
+                    "flat/fused BiCG (levels<=1 config); the gather "
+                    "path is measured separately (battery key "
+                    "poisson_gather) for the 0.13x attribution — CPU "
+                    "XLA runs the same gather solve at 14.2e6 "
+                    "cell-iters/s, above the r3 TPU number, so the TPU "
+                    "gather lowering is the suspect",
+                "dispatch_calibration": "the flat-vs-boxed edge now "
+                    "reads tools/dispatch_calibration.json; "
+                    "tools/recalibrate.py --write produces it from the "
+                    "battery's pinned refined_boxed + sweep keys",
+            },
             "onchip_battery": battery,
             "multidev_cpu": r8,
         },
