@@ -140,3 +140,100 @@ def test_balance_load_merges_remote_pins_and_weights(monkeypatch):
     # temporary, dccrg.hpp:8297-8340)
     assert g.pin_requests == {3: 0}
     assert g.cell_weights == {}
+
+
+# ------------------------------------------------- p2p transport unit
+
+def _make_transport(rank):
+    """A _P2PTransport wired by hand (no process_allgather): listener
+    bound, address book patched in afterwards by the caller."""
+    import socket
+
+    from dccrg_tpu.utils.collectives import _P2PTransport
+
+    t = _P2PTransport.__new__(_P2PTransport)
+    t.rank = rank
+    t.sent_to = {}
+    t.received_from = {}
+    t._pair_seq = {}
+    t._pending = {}
+    t._listener = socket.socket()
+    t._listener.bind(("127.0.0.1", 0))
+    t._listener.listen(128)
+    return t
+
+
+def test_p2p_exchange_pair_and_payload_sizes():
+    """Symmetric exchange between two in-process transports, from 8-byte
+    scalars to megabyte payloads (the threaded sends must not deadlock
+    on payloads past the kernel socket buffers)."""
+    import threading
+
+    a, b = _make_transport(0), _make_transport(1)
+    book = [("127.0.0.1", t._listener.getsockname()[1]) for t in (a, b)]
+    a.addrs = b.addrs = book
+
+    try:
+        for size in (8, 1 << 21):
+            pa, pb = b"A" * size, b"B" * size
+            out = {}
+
+            def run(t, payload, key):
+                out[key] = t.exchange(payload, [1 - t.rank])
+
+            th = threading.Thread(target=run, args=(b, pb, "b"))
+            th.start()
+            run(a, pa, "a")
+            th.join(timeout=60)
+            assert out["a"] == {1: pb} and out["b"] == {0: pa}
+        assert a.sent_to[1] == 8 + (1 << 21)
+        assert a.received_from[1] == 8 + (1 << 21)
+    finally:
+        a._listener.close()
+        b._listener.close()
+
+
+def test_p2p_stash_absorbs_mismatched_peer_sets():
+    """Three transports; 1 and 2 run a pair exchange while 0 goes
+    straight to the clique: 0's early connect to 2 must be stashed and
+    consumed when 2 reaches the clique (not rejected)."""
+    import threading
+    import time
+
+    ts = [_make_transport(r) for r in range(3)]
+    book = [("127.0.0.1", t._listener.getsockname()[1]) for t in ts]
+    for t in ts:
+        t.addrs = book
+
+    results = {}
+
+    def run0():
+        results[0] = ts[0].exchange(b"zero0000", [1, 2])
+
+    def run1():
+        # let rank 0's clique connect land in the backlogs FIRST, so
+        # the stash branch is exercised deterministically, not by
+        # thread-scheduling luck
+        time.sleep(0.3)
+        results["pair1"] = ts[1].exchange(b"pair1111", [2])
+        results[1] = ts[1].exchange(b"one11111", [0, 2])
+
+    def run2():
+        time.sleep(0.3)
+        results["pair2"] = ts[2].exchange(b"pair2222", [1])
+        results[2] = ts[2].exchange(b"two22222", [0, 1])
+
+    threads = [threading.Thread(target=f) for f in (run0, run1, run2)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+    finally:
+        for t in ts:
+            t._listener.close()
+    assert results["pair1"] == {2: b"pair2222"}
+    assert results["pair2"] == {1: b"pair1111"}
+    assert results[0] == {1: b"one11111", 2: b"two22222"}
+    assert results[1] == {0: b"zero0000", 2: b"two22222"}
+    assert results[2] == {0: b"zero0000", 1: b"one11111"}
