@@ -44,6 +44,10 @@ LARGE = (512, 512, 128)  # f32 density alone is 128 MiB: cannot fit VMEM
 LARGE_STEPS = 200
 GOL_N = 500              # the reference example's board (game_of_life.cpp)
 VLASOV_N = 32            # spatial grid (BASELINE.md config 5)
+PIC_N = 1_000_000        # particles (BASELINE.md config 4)
+PIC_GRID = 32            # uniform PIC grid edge
+PIC_REFINED_N = 200_000  # particles for the refined+balanced variant
+PIC_REFINED_GRID = 16    # coarse edge of the refined PIC grid
 VLASOV_NV = 8            # velocity bins per dimension (nv^3 per cell)
 GOL_TURNS = 20000
 
@@ -305,8 +309,8 @@ def measure_pic() -> dict:
 
     from benchmarks.microbench import pic_setup
 
-    length = 32
-    n_particles = 1_000_000
+    length = PIC_GRID
+    n_particles = PIC_N
     pc, pts, vel = pic_setup(n_particles, length)
     assert pc._dev_rebucket is not None, "device re-bucket must engage"
     state = pc.new_state(pts)
@@ -321,12 +325,48 @@ def measure_pic() -> dict:
     # a physically valid run: every particle accounted for, none dropped
     assert pc.count(out) == n_particles, "particle conservation violated"
     assert int(np.asarray(out["overflow"])) == 0, "particles dropped"
-    return {
+    result = {
         "n_particles": n_particles,
         "steps": steps,
         "pushes_per_s_incl_migration": n_particles * steps / secs,
         "times_s": [round(t, 4) for t in times],
     }
+    # refined + load-balanced variant: the generalized device re-bucket
+    # (keyed on the epoch row-id tables) on the reference's actual
+    # particle use case — AMR grid, non-block ownership
+    # (tests/particles/simple.cpp runs under balance_load as a matter of
+    # course).  A failure here must not discard the measured uniform
+    # number above (partial results still count).
+    try:
+        n_ref = PIC_REFINED_N
+        pr, pts_r, vel_r = pic_setup(
+            n_ref, PIC_REFINED_GRID, max_ref=1, refine_ball=0.25,
+            balance_method="HSFC", seed=1,
+        )
+        assert pr._dev_rebucket is not None, (
+            "refined+balanced grid must stay on the device re-bucket"
+        )
+        sr = pr.new_state(pts_r)
+        dt_r = 0.1 / PIC_REFINED_GRID
+        jax.block_until_ready(
+            pr.run(sr, 2, velocity=vel_r, dt=dt_r)["particles"]
+        )
+        secs_r, times_r, out_r = _median_of(
+            lambda: pr.run(sr, steps, velocity=vel_r, dt=dt_r), n=3
+        )
+        assert pr.count(out_r) == n_ref
+        assert int(np.asarray(out_r["overflow"])) == 0
+        result["refined_lb"] = {
+            "n_cells": len(pr.grid.get_cells()),
+            "n_particles": n_ref,
+            "n_devices": 1,
+            "pushes_per_s_incl_migration": n_ref * steps / secs_r,
+            "times_s": [round(t, 4) for t in times_r],
+        }
+    except Exception as e:  # noqa: BLE001 - keep the uniform number
+        print(f"refined_lb pic variant failed: {e}", file=sys.stderr)
+        result["refined_lb"] = {"error": str(e)[-300:]}
+    return result
 
 
 def measure_poisson(allow_flat: bool = True, use_pallas: bool = True,

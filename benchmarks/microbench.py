@@ -236,13 +236,21 @@ def bench_epoch_rebuild(length: int = 64):
     }))
 
 
-def pic_setup(n_particles: int, length: int = 32):
+def pic_setup(n_particles: int, length: int = 32, *, max_ref: int = 0,
+              refine_ball: float | None = None,
+              balance_method: str | None = None, seed: int = 0):
     """Shared PIC benchmark fixture (also used by the root bench.py):
-    uniform periodic grid, uniformly-random particles, capacity from the
-    actual max occupancy (Poisson tails overflow any fixed multiple of
-    the mean — doubled for drift during the run), and the rotating
-    velocity field of the reference's particle test.  Returns
-    ``(particles_model, initial_points, velocity_field)``."""
+    periodic grid, uniformly-random particles, capacity from the actual
+    max occupancy (Poisson tails overflow any fixed multiple of the
+    mean — doubled for drift during the run), and the rotating velocity
+    field of the reference's particle test.  Returns
+    ``(particles_model, initial_points, velocity_field)``.
+
+    ``refine_ball``: refine every cell within that radius of the domain
+    center (requires ``max_ref >= 1``); ``balance_method``: run a
+    ``balance_load`` under the given partitioner after refinement — the
+    reference's actual particle use case (AMR + non-block ownership,
+    ``tests/particles/simple.cpp``)."""
     from dccrg_tpu import CartesianGeometry, Grid, make_mesh
     from dccrg_tpu.models.particles import Particles
 
@@ -251,6 +259,8 @@ def pic_setup(n_particles: int, length: int = 32):
         .set_initial_length((length, length, length))
         .set_neighborhood_length(1)
         .set_periodic(True, True, True)
+        .set_maximum_refinement_level(max_ref)
+        .set_load_balancing_method(balance_method or "RCB")
         .set_geometry(
             CartesianGeometry,
             start=(0.0, 0.0, 0.0),
@@ -258,7 +268,16 @@ def pic_setup(n_particles: int, length: int = 32):
         )
         .initialize(mesh=make_mesh(n_devices=1))
     )
-    rng = np.random.default_rng(0)
+    if refine_ball is not None:
+        ids = g.get_cells()
+        ctr = g.geometry.get_center(ids)
+        rr = np.linalg.norm(ctr - 0.5, axis=1)
+        for cid in ids[rr < refine_ball]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    if balance_method is not None:
+        g.balance_load()
+    rng = np.random.default_rng(seed)
     pts = rng.uniform(0.0, 1.0, size=(n_particles, 3))
     occ = np.bincount(g.leaves.position(g.get_existing_cell(pts)))
     pc = Particles(g, max_particles_per_cell=2 * int(occ.max()))
