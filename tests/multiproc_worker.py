@@ -257,6 +257,43 @@ def main() -> None:
     sr = some_reduce(grid, counts, 0)
     res["some_reduce"] = {"device0": int(sr), "clique": int(full)}
 
+    # ---- scenario 8: particles across the process boundary ----------
+    # the device re-bucket's shard_map (per-device sort + psum loss
+    # accounting) spans the controller processes; a refined grid engages
+    # the generalized row-table path (reference particle migration
+    # between ranks, tests/particles/simple.cpp:285-294)
+    from dccrg_tpu import CartesianGeometry
+    from dccrg_tpu.models import Particles
+
+    gp2 = (
+        Grid()
+        .set_initial_length((4, 4, dpp * nproc))
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(0.25, 0.25, 1.0 / (dpp * nproc)),
+        )
+        .initialize(mesh=make_mesh())
+    )
+    assert gp2.refine_completely(int(gp2.get_cells()[0]))
+    gp2.stop_refining()
+    assert gp2.mapping.get_refinement_level(gp2.leaves.cells).max() == 1
+    pic = Particles(gp2, max_particles_per_cell=64)
+    assert pic._dev_rebucket is not None, "device re-bucket must engage"
+    rng = np.random.default_rng(42)   # same seed on every controller
+    pts = rng.uniform(0.0, 1.0, size=(120, 3))
+    sp2 = pic.new_state(pts)
+    sp2 = pic.run(sp2, 5, velocity=(0.03, 0.02, 0.11), dt=0.5)
+    assert pic.count(sp2) == 120, "particle conservation across processes"
+    assert int(np.asarray(fetch(sp2["overflow"]))) == 0
+    res["particles"] = {
+        "count": pic.count(sp2),
+        "pos_hash": _hash(np.sort(pic.positions(sp2), axis=0).round(12)),
+    }
+
     print("RESULT " + json.dumps(res), flush=True)
 
 
