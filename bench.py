@@ -738,6 +738,62 @@ def measure_cpu_vlasov_baseline() -> float:
 _REAL_BENCH_TIMEOUT_S = int(os.environ.get("DCCRG_BENCH_TIMEOUT", 2700))
 
 
+def _summarize(d: dict) -> dict:
+    """Tiny per-workload summary for the compact headline line."""
+    s: dict = {"full": "BENCH_DETAIL.json"}
+
+    def pick(name, *path):
+        x = d
+        for p in path:
+            if not isinstance(x, dict) or p not in x:
+                return
+            x = x[p]
+        if isinstance(x, (int, float)) and not isinstance(x, bool):
+            s[name] = round(float(x), 3 if abs(x) < 1000 else 1)
+
+    pick("refined_upd_s", "refined", "updates_per_s")
+    pick("refined_vs", "refined", "vs_baseline")
+    pick("large_upd_s", "large", "updates_per_s")
+    pick("large_vs", "large", "vs_baseline")
+    pick("gol_upd_s", "gol", "updates_per_s")
+    pick("gol_vs", "gol", "vs_baseline")
+    pick("poisson_iters_s", "poisson", "cell_iterations_per_s")
+    pick("poisson_vs", "poisson", "uniform", "vs_baseline")
+    pick("vlasov_upd_s", "vlasov", "phase_updates_per_s")
+    pick("vlasov_vs", "vlasov", "vs_baseline")
+    pick("pic_push_s", "pic", "pushes_per_s_incl_migration")
+    if "error" in d:
+        s["fallback"] = True
+        pick("last_headline", "last_measured_this_round",
+             "headline_median_updates_per_s_per_chip")
+        pick("last_headline_vs", "last_measured_this_round",
+             "vs_baseline_headline")
+    return s
+
+
+def _emit(record: dict):
+    """Persist the full record to BENCH_DETAIL.json; print a compact
+    (<1 kB) headline JSON as the FINAL stdout line so the driver's 2 kB
+    tail capture always round-trips through json.loads (VERDICT-r4
+    weak #1) — in the outage fallback too."""
+    try:
+        (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
+    except OSError as e:
+        print(f"could not write BENCH_DETAIL.json: {e}", file=sys.stderr)
+    compact = {
+        "metric": record.get("metric"),
+        "value": record.get("value"),
+        "unit": record.get("unit"),
+        "vs_baseline": record.get("vs_baseline"),
+        "detail": _summarize(record.get("detail") or {}),
+    }
+    line = json.dumps(compact)
+    if len(line) > 1000:  # hard guarantee: never outgrow the tail capture
+        compact["detail"] = {"full": "BENCH_DETAIL.json"}
+        line = json.dumps(compact)
+    print(line)
+
+
 def main():
     """Run the real measurement in a child process under a hard timeout.
 
@@ -792,7 +848,10 @@ def main():
         )
         if r.returncode == 0 and line:
             sys.stderr.write(r.stderr)
-            print(line)
+            try:
+                _emit(json.loads(line))
+            except json.JSONDecodeError:
+                print(line)
             return
         diag = {"rc": r.returncode, "stderr_tail": r.stderr[-800:]}
     except subprocess.TimeoutExpired as e:
@@ -833,7 +892,7 @@ def _emit_fallback(diag):
             battery = battery or None
         except Exception:  # noqa: BLE001
             battery = None
-    print(json.dumps({
+    _emit({
         "metric": "3d_advection_cell_updates_per_sec_per_chip",
         "value": -1.0,
         "unit": "cell-updates/s/chip",
@@ -901,7 +960,7 @@ def _emit_fallback(diag):
             "onchip_battery": battery,
             "multidev_cpu": r8,
         },
-    }))
+    })
 
 
 def _main_real():
