@@ -5,8 +5,12 @@ The reference delegates repartitioning to Zoltan (13 callbacks,
 (``make_new_partition``, ``dccrg.hpp:8349-8581``).  Here the partitioners
 are implemented natively over the replicated leaf directory:
 
-* ``RCB``/``RIB`` — weighted recursive coordinate bisection over cell
-  centers (Zoltan's geometric methods);
+* ``RCB`` — weighted recursive coordinate bisection over cell centers
+  (axis-aligned cuts along the widest extent);
+* ``RIB`` — weighted recursive inertial bisection: each cut is
+  perpendicular to the principal axis of the sub-population's weighted
+  inertia tensor, so elongated off-axis distributions split along their
+  true long direction (Zoltan's distinct RIB method);
 * ``HSFC``/``SFC``/``HILBERT`` — Hilbert space-filling-curve striping with
   weight-balanced cuts (the curve sfc++ gives the reference);
 * ``MORTON`` — Z-order striping (cheaper keys, less compact parts);
@@ -37,7 +41,8 @@ import numpy as np
 
 from .partition import hilbert_partition, morton_partition, weighted_blocks
 
-__all__ = ["compute_partition", "rcb_partition", "RESERVED_OPTIONS"]
+__all__ = ["compute_partition", "rcb_partition", "rib_partition",
+           "RESERVED_OPTIONS"]
 
 #: Zoltan parameters the reference reserves for dccrg itself
 #: (``dccrg.hpp:7716-7723``) — ``set_partitioning_option`` /
@@ -118,6 +123,61 @@ def rcb_partition(
     return owner
 
 
+def rib_partition(
+    centers: np.ndarray, n_parts: int, weights: np.ndarray | None = None
+) -> np.ndarray:
+    """Weighted recursive inertial bisection (Zoltan's RIB method, the
+    reference's ``LB_METHOD=RIB``): project the sub-population onto the
+    principal axis of its weighted inertia (the largest-eigenvalue
+    eigenvector of the weighted covariance of the centers), cut at the
+    weighted part-count-proportional point, recurse.  Unlike RCB the cut
+    planes are not axis-aligned, so a distribution elongated along an
+    oblique direction is split across its true long axis."""
+    n = len(centers)
+    w = (np.ones(n) if weights is None
+         else np.maximum(np.asarray(weights, float), 0.0))
+    owner = np.zeros(n, dtype=np.int32)
+
+    def principal_axis(c: np.ndarray, wi: np.ndarray) -> np.ndarray:
+        tot = wi.sum()
+        if tot <= 0:
+            wi = np.ones(len(c))
+            tot = float(len(c))
+        mu = (wi[:, None] * c).sum(axis=0) / tot
+        d = c - mu
+        cov = (wi[:, None] * d).T @ d
+        _vals, vecs = np.linalg.eigh(cov)  # ascending eigenvalues
+        axis = vecs[:, -1]
+        # deterministic sign (eigh's is arbitrary): first nonzero
+        # component positive, so reruns and controllers agree
+        nz = np.flatnonzero(np.abs(axis) > 1e-12)
+        if len(nz) and axis[nz[0]] < 0:
+            axis = -axis
+        return axis
+
+    def recurse(idx: np.ndarray, parts: int, first: int):
+        if parts <= 1 or len(idx) == 0:
+            owner[idx] = first
+            return
+        left_parts = parts // 2
+        frac = left_parts / parts
+        c = centers[idx]
+        proj = c @ principal_axis(c, w[idx])
+        order = np.argsort(proj, kind="stable")
+        cum = np.cumsum(w[idx][order])
+        total = cum[-1]
+        if total <= 0:
+            cut = int(round(len(idx) * frac))
+        else:
+            cut = int(np.searchsorted(cum, frac * total))
+        cut = min(max(cut, 1), len(idx) - 1)
+        recurse(idx[order[:cut]], left_parts, first)
+        recurse(idx[order[cut:]], parts - left_parts, first + left_parts)
+
+    recurse(np.arange(n), n_parts, 0)
+    return owner
+
+
 def compute_partition(
     method: str,
     grid,
@@ -153,9 +213,12 @@ def compute_partition(
         idx = mapping.get_indices(leaves.cells)
         z0 = idx[:, 2].astype(np.int64) >> mapping.max_refinement_level
         return (z0 // (nz0 // n_parts)).astype(np.int32)
-    if method in ("RCB", "RIB"):
+    if method == "RCB":
         centers = grid.geometry.get_center(leaves.cells)
         return rcb_partition(centers, n_parts, weights)
+    if method == "RIB":
+        centers = grid.geometry.get_center(leaves.cells)
+        return rib_partition(centers, n_parts, weights)
     if method in ("HSFC", "SFC", "HILBERT"):
         return hilbert_partition(grid.mapping, leaves.cells, n_parts, weights, tol)
     if method == "MORTON":

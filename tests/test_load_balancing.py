@@ -29,6 +29,63 @@ def test_balance_produces_even_partition(method):
     assert counts.max() - counts.min() <= 2
 
 
+def test_rib_beats_rcb_on_oblique_distribution():
+    """RIB is a real inertial bisection, not an RCB alias: on a weighted
+    cloud elongated along the xy diagonal (largest *variance*) but with a
+    wider z *extent*, RCB cuts z while RIB cuts the diagonal, giving
+    measurably lower within-part weighted scatter (reference: Zoltan RIB
+    as a distinct LB_METHOD, dccrg.hpp:7715-7733)."""
+    from dccrg_tpu.parallel.loadbalance import rcb_partition, rib_partition
+
+    rng = np.random.default_rng(7)
+    n = 4000
+    t = rng.uniform(-5, 5, n)
+    centers = np.stack([
+        t / np.sqrt(2) + rng.normal(0, 0.2, n),
+        t / np.sqrt(2) + rng.normal(0, 0.2, n),
+        rng.uniform(-4, 4, n),
+    ], axis=1)
+    w = rng.uniform(0.5, 2.0, n)
+
+    def scatter(owner, k):
+        s = 0.0
+        for p in range(k):
+            m = owner == p
+            wp, c = w[m], centers[m]
+            mu = (wp[:, None] * c).sum(0) / wp.sum()
+            s += (wp[:, None] * (c - mu) ** 2).sum()
+        return s
+
+    for k in (2, 8):
+        rcb = rcb_partition(centers, k, w)
+        rib = rib_partition(centers, k, w)
+        assert scatter(rib, k) < scatter(rcb, k)
+        loads = np.bincount(rib, weights=w, minlength=k)
+        assert loads.max() <= 1.05 * loads.sum() / k
+        assert loads.min() > 0
+
+
+def test_rib_balances_through_grid():
+    """RIB routes through balance_load distinctly from RCB and balances
+    cell counts on a uniform grid."""
+    from dccrg_tpu.parallel.loadbalance import compute_partition
+
+    g = make_grid("RIB", length=(8, 8, 8))
+    g.balance_load()
+    counts = np.bincount(g.get_owner(g.get_cells()), minlength=8)
+    assert counts.sum() == 512
+    assert counts.max() - counts.min() <= 2
+    # with weights concentrated on an oblique band the two geometric
+    # methods must produce different partitions (RIB is not an alias)
+    c = g.geometry.get_center(g.get_cells())
+    d = np.abs(c[:, 0] - c[:, 1]) / np.sqrt(2)
+    wts = np.where(d < 1.0, 100.0, 1.0)
+    assert not np.array_equal(
+        compute_partition("RIB", g, 8, wts),
+        compute_partition("RCB", g, 8, wts),
+    )
+
+
 def test_none_keeps_partition():
     g = make_grid("NONE")
     before = g.get_owner(g.get_cells())
