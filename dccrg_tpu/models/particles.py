@@ -35,10 +35,19 @@ __all__ = ["Particles"]
 
 
 class Particles:
-    def __init__(self, grid, max_particles_per_cell: int = 64, hood_id=None):
+    def __init__(self, grid, max_particles_per_cell: int = 64, hood_id=None,
+                 dtype=None):
         self.grid = grid
         self.P = int(max_particles_per_cell)
         self.hood_id = hood_id
+        # coordinate dtype: f64 where x64 is enabled (the reference stores
+        # doubles), otherwise f32 up front — requesting f64 under default
+        # jax settings would silently truncate with a warning per alloc
+        if dtype is None:
+            import jax
+
+            dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+        self.dtype = np.dtype(dtype)
         self.tables = StencilTables(grid, hood_id)
         self._exchange = grid.halo(hood_id)
         self._push = self._build_push()
@@ -46,7 +55,7 @@ class Particles:
 
     def spec(self):
         return {
-            "particles": ((self.P, 3), np.float64),
+            "particles": ((self.P, 3), self.dtype),
             "number_of_particles": ((), np.int32),
         }
 

@@ -45,7 +45,7 @@ class Poisson:
     BOUNDARY_CELL = 1
     SKIP_CELL = 2
 
-    def __init__(self, grid, hood_id=None, dtype=np.float64,
+    def __init__(self, grid, hood_id=None, dtype=None,
                  solve_cells=None, skip_cells=None, allow_flat=True,
                  use_pallas=True):
         #: use_pallas follows the Advection convention: True = compiled
@@ -53,6 +53,14 @@ class Poisson:
         #: (CI/CPU coverage); False = XLA only
         self.grid = grid
         self.hood_id = hood_id
+        # default dtype: f64 where x64 is enabled (the reference solves in
+        # doubles), otherwise f32 up front instead of a per-alloc
+        # truncation warning
+        if dtype is None:
+            import jax
+
+            dtype = (np.float64 if jax.config.jax_enable_x64
+                     else np.float32)
         self.dtype = dtype
         self.use_pallas = use_pallas
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
@@ -447,6 +455,11 @@ class Poisson:
                     break  # converged, or the attempt made no progress
                 prev_res = res
             return state, res, total_it
+        # threshold dtype: f64 under x64, f32 otherwise — canonicalized
+        # without the per-call truncation warning jnp.float64() emits
+        import jax
+
+        td = jax.dtypes.canonicalize_dtype(np.float64)
         if self._solve_fast is not None:
             from ..utils.fallback import fallback_call
 
@@ -459,8 +472,8 @@ class Poisson:
                 ),
                 lambda: self._solve(
                     state, jnp.int32(max_iterations),
-                    jnp.float64(stop_residual),
-                    jnp.float64(stop_after_residual_increase),
+                    jnp.asarray(stop_residual, td),
+                    jnp.asarray(stop_after_residual_increase, td),
                 ),
                 self._disable_fast,
             )
@@ -468,8 +481,8 @@ class Poisson:
         state, res, it = self._solve(
             state,
             jnp.int32(max_iterations),
-            jnp.float64(stop_residual),
-            jnp.float64(stop_after_residual_increase),
+            jnp.asarray(stop_residual, td),
+            jnp.asarray(stop_after_residual_increase, td),
         )
         return state, float(res), int(it)
 
