@@ -34,6 +34,7 @@ __all__ = [
     "union_u64",
     "sync_adaptation",
     "sync_partition_inputs",
+    "assert_agreement",
     "barrier",
     "all_gather",
     "all_reduce",
@@ -185,6 +186,36 @@ def sync_partition_inputs(pin_requests: dict, cell_weights: dict) -> tuple:
     return merged_pins, merged_weights
 
 
+def assert_agreement(tag: str, payload: bytes) -> None:
+    """ENFORCED multi-controller agreement for host-side mutator inputs
+    (VERDICT-r4 missing 4): hash the local inputs and compare across
+    every controller over the collectives seam; any mismatch raises on
+    ALL controllers (each sees the differing digest) instead of letting
+    the grids silently diverge.  The reference gets this structurally
+    from SPMD collectives (``dccrg.hpp:6383-6603``); here the helpers
+    the mutators run on are host-local, so agreement must be checked.
+    Identity with one controller."""
+    if process_count() == 1:
+        return
+    import hashlib
+
+    # the tag is part of the digest: two DIFFERENT mutators with
+    # coincidentally equal payload bytes must not falsely agree
+    digest = np.frombuffer(
+        hashlib.sha256(tag.encode() + b"\0" + payload).digest()[:8],
+        dtype=np.uint64,
+    ).copy()
+    rows = allgather_u64(digest)
+    mine = int(digest[0])
+    bad = [p for p, r in enumerate(rows) if int(r[0]) != mine]
+    if bad:
+        raise RuntimeError(
+            f"controllers disagree on {tag}: this process's inputs "
+            f"differ from process(es) {bad} — {tag} must be called with "
+            "identical arguments on every controller"
+        )
+
+
 def barrier(name: str = "dccrg") -> None:
     """Cross-controller synchronization point (the role of
     ``MPI_Barrier`` around the reference's collective file IO,
@@ -252,6 +283,7 @@ class _P2PTransport:
         return cls._instance
 
     def __init__(self):
+        import secrets
         import socket
         import struct
 
@@ -265,15 +297,32 @@ class _P2PTransport:
         #: in a later exchange whose peer set includes us while ours for
         #: the current exchange does not) — consumed when we get there
         self._pending: dict[int, tuple[int, bytes, object]] = {}
+        # bind to the advertised interface, not 0.0.0.0: the port should
+        # only be reachable the way peers will actually dial it
+        ip = self._advertised_ip()
         self._listener = socket.socket()
-        self._listener.bind(("0.0.0.0", 0))
+        try:
+            self._listener.bind((ip, 0))
+        except OSError:
+            # the advertised address may not be a local bindable address
+            # in NAT'd topologies (DCCRG_P2P_HOST names the public side)
+            self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(128)
         port = self._listener.getsockname()[1]
-        ip_u32 = struct.unpack("!I", socket.inet_aton(self._advertised_ip()))[0]
-        book = _process_allgather(np.asarray([ip_u32, port], dtype=np.uint64))
+        ip_u32 = struct.unpack("!I", socket.inet_aton(ip))[0]
+        # per-job shared token: every process contributes random bits and
+        # the XOR travels only over the jax-distributed allgather, so any
+        # party outside the job cannot know it; message headers carrying a
+        # different token are rejected instead of consumed
+        token_part = secrets.randbits(64)
+        book = _process_allgather(
+            np.asarray([ip_u32, port, token_part], dtype=np.uint64)
+        )
+        book = np.atleast_2d(book)
+        self.token = int(np.bitwise_xor.reduce(book[:, 2].astype(np.uint64)))
         self.addrs = [
             (socket.inet_ntoa(struct.pack("!I", int(row[0]))), int(row[1]))
-            for row in np.atleast_2d(book)
+            for row in book
         ]
 
     @staticmethod
@@ -304,7 +353,18 @@ class _P2PTransport:
         except OSError:
             return "127.0.0.1"
 
-    _HEADER = "!III"          # sender rank, per-pair sequence, payload bytes
+    #: sender rank, per-pair sequence, shared job token, payload bytes
+    _HEADER = "!IIQI"
+
+    @staticmethod
+    def _timeout() -> float:
+        """Per-socket-operation timeout (seconds).  Large payloads on a
+        congested link or a peer stuck in a long XLA compile may
+        legitimately need more than the default; ``DCCRG_P2P_TIMEOUT``
+        raises it without code changes."""
+        import os
+
+        return float(os.environ.get("DCCRG_P2P_TIMEOUT", "120"))
 
     @staticmethod
     def _recvn(sock, n: int) -> bytes:
@@ -333,7 +393,9 @@ class _P2PTransport:
         import socket
         import struct
         import threading
+        import warnings
 
+        timeout = self._timeout()
         peers = sorted({int(p) for p in peers} - {self.rank})
         out: dict[int, bytes] = {}
         conns = []
@@ -356,10 +418,17 @@ class _P2PTransport:
         # initiate toward higher ranks (lower rank of each pair connects)
         for p in (q for q in peers if q > self.rank):
             seq = self._pair_seq[p] = self._pair_seq.get(p, 0) + 1
-            s = socket.create_connection(self.addrs[p], timeout=120)
-            s.settimeout(120)
+            try:
+                s = socket.create_connection(self.addrs[p], timeout=timeout)
+            except (socket.timeout, TimeoutError) as e:
+                raise TimeoutError(
+                    f"p2p connect to process {p} (pair seq {seq}) timed "
+                    f"out after {timeout}s; raise DCCRG_P2P_TIMEOUT if "
+                    "the peer is legitimately slow"
+                ) from e
+            s.settimeout(timeout)
             spawn_send(s, struct.pack(self._HEADER, self.rank, seq,
-                                      len(payload)) + payload)
+                                      self.token, len(payload)) + payload)
             conns.append((p, seq, s))
             self.sent_to[p] = self.sent_to.get(p, 0) + len(payload)
 
@@ -372,7 +441,7 @@ class _P2PTransport:
                 )
             out[rk] = body
             spawn_send(conn, struct.pack(self._HEADER, self.rank, my_seq,
-                                         len(payload)) + payload)
+                                         self.token, len(payload)) + payload)
             self.received_from[rk] = self.received_from.get(rk, 0) + len(body)
             self.sent_to[rk] = self.sent_to.get(rk, 0) + len(payload)
 
@@ -384,13 +453,28 @@ class _P2PTransport:
             serve_lower(rk, seq, body, conn)
             served.append(conn)
             expect.discard(rk)
-        self._listener.settimeout(120)
+        self._listener.settimeout(timeout)
         while expect:
-            c, _ = self._listener.accept()
-            c.settimeout(120)
-            rk, seq, nbytes = struct.unpack(
+            try:
+                c, addr = self._listener.accept()
+            except (socket.timeout, TimeoutError) as e:
+                raise TimeoutError(
+                    f"p2p accept timed out after {timeout}s still waiting "
+                    f"for processes {sorted(expect)}; raise "
+                    "DCCRG_P2P_TIMEOUT if a peer is legitimately slow"
+                ) from e
+            c.settimeout(timeout)
+            rk, seq, token, nbytes = struct.unpack(
                 self._HEADER, self._recvn(c, hdr_n)
             )
+            if token != self.token:
+                # not a member of this job (or a stray/injected message):
+                # refuse it — it must never be consumed as a contribution
+                warnings.warn(
+                    f"p2p message from {addr} rejected: bad job token"
+                )
+                c.close()
+                continue
             body = self._recvn(c, nbytes)
             if rk not in expect:
                 # a peer already in a later exchange that includes us —
@@ -407,17 +491,25 @@ class _P2PTransport:
             expect.discard(rk)
         # collect responses from higher ranks
         for p, seq, s in conns:
-            rk, r_seq, nbytes = struct.unpack(
-                self._HEADER, self._recvn(s, hdr_n)
-            )
-            if rk != p or r_seq != seq:
+            try:
+                rk, r_seq, token, nbytes = struct.unpack(
+                    self._HEADER, self._recvn(s, hdr_n)
+                )
+                body = self._recvn(s, nbytes)
+            except (socket.timeout, TimeoutError) as e:
+                raise TimeoutError(
+                    f"p2p response from process {p} (pair seq {seq}) "
+                    f"timed out after {timeout}s; raise DCCRG_P2P_TIMEOUT "
+                    "if the peer is legitimately slow"
+                ) from e
+            if rk != p or r_seq != seq or token != self.token:
                 raise RuntimeError(
                     f"p2p response out of step from process {p}"
                 )
-            out[p] = self._recvn(s, nbytes)
+            out[p] = body
             self.received_from[p] = self.received_from.get(p, 0) + nbytes
         for t in senders:
-            t.join(timeout=120)
+            t.join(timeout=timeout)
         for s in served + [s for _, _, s in conns]:
             s.close()
         if errors:
@@ -496,6 +588,15 @@ def some_reduce(grid, per_device_values, device: int, op=np.add, hood_id=None):
         ).reshape((k,) + item.shape)
         for d, v in zip(devs, peer_vals):
             by_device[int(d)] = v
-    assert len(by_device) == len(members), "missing member contributions"
-    ordered = np.stack([by_device[int(d)] for d in sorted(by_device)])
+    # explicit check (not an assert: under python -O a missing
+    # contribution must still fail, never silently reduce over fewer
+    # members), and the reduce iterates the member list itself so an
+    # EXTRA stray contribution cannot widen the reduction either
+    missing = {int(d) for d in members} - set(by_device)
+    if missing:
+        raise RuntimeError(
+            f"some_reduce missing contributions for devices "
+            f"{sorted(missing)}"
+        )
+    ordered = np.stack([by_device[int(d)] for d in members])  # ascending
     return op.reduce(ordered, axis=0)

@@ -10,8 +10,10 @@ failing call on the fallback path first.  If the fallback also raises,
 the error is the caller's and propagates unchanged.  If the fallback
 succeeds, the fast path is disabled for the instance only when the
 error is a compile/lowering rejection (which would recur on every
-call); transient runtime faults fall back for this call only, so the
-kernel gets another chance next step.
+call): immediately for a typed ``NotImplementedError``, after two
+consecutive marker-text hits otherwise (a transient error's text can
+coincidentally contain a marker).  Transient runtime faults fall back
+for this call only, so the kernel gets another chance next step.
 """
 from __future__ import annotations
 
@@ -32,10 +34,22 @@ _MAX_TRANSIENT_FALLS = 3
 #: the count survives across calls and dies with the instance
 _transient_falls: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
+#: per-kernel-instance consecutive marker-hit counters: a *typed*
+#: rejection (NotImplementedError) disables on the first hit, but the
+#: substring markers below can coincidentally appear in a transient
+#: runtime/RPC error's text, so marker-classified errors must recur on
+#: the immediately following call before the fast path is disabled for
+#: the instance lifetime
+_marker_hits: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+
+#: consecutive marker hits that prove the rejection deterministic
+_MARKER_HITS_TO_DISABLE = 2
+
 #: substrings that identify a deterministic compiler rejection of the
 #: kernel itself — these recur on every call, so the fast path is
-#: permanently disabled.  Anything else (RESOURCE_EXHAUSTED, connection
-#: drops, cancelled RPCs) is treated as transient.
+#: permanently disabled once they repeat.  Anything else
+#: (RESOURCE_EXHAUSTED, connection drops, cancelled RPCs) is treated as
+#: transient.
 _PERMANENT_MARKERS = (
     "Mosaic",            # TPU kernel compiler errors are prefixed with this
     "lowering",          # jax "unsupported lowering" / "lowering rule" paths
@@ -46,8 +60,8 @@ _PERMANENT_MARKERS = (
 
 
 def _is_permanent(e: Exception) -> bool:
-    """Whether the fast path's failure is a deterministic lowering /
-    compile rejection (vs a transient runtime fault)."""
+    """Whether the fast path's failure looks like a deterministic
+    lowering / compile rejection (vs a transient runtime fault)."""
     if isinstance(e, NotImplementedError):
         return True
     text = f"{type(e).__name__}: {e}"
@@ -87,16 +101,21 @@ def fallback_call(label, fast, slow, disable, *args):
         except Exception:
             raise e  # both paths fail: the input was bad, not the kernel
         falls = _transient_falls.get(key, 0) + 1
-        if _is_permanent(e) or falls >= _MAX_TRANSIENT_FALLS:
+        hits = _marker_hits.get(key, 0) + 1 if _is_permanent(e) else 0
+        if (isinstance(e, NotImplementedError)
+                or hits >= _MARKER_HITS_TO_DISABLE
+                or falls >= _MAX_TRANSIENT_FALLS):
             print(f"{label} disabled ({e!r:.200}); using the fallback path",
                   file=sys.stderr)
             disable()
         else:
             _transient_falls[key] = falls
+            _marker_hits[key] = hits  # 0 resets: hits must be consecutive
             print(f"{label} fell back ({falls}/{_MAX_TRANSIENT_FALLS}, "
                   f"{e!r:.200}); will retry the fast path next call",
                   file=sys.stderr)
         return out
     else:
         _transient_falls.pop(key, None)
+        _marker_hits.pop(key, None)
         return out

@@ -153,6 +153,7 @@ def _make_transport(rank):
 
     t = _P2PTransport.__new__(_P2PTransport)
     t.rank = rank
+    t.token = 0x5EC0DE              # same job token for all test peers
     t.sent_to = {}
     t.received_from = {}
     t._pair_seq = {}
@@ -237,3 +238,47 @@ def test_p2p_stash_absorbs_mismatched_peer_sets():
     assert results[0] == {1: b"one11111", 2: b"two22222"}
     assert results[1] == {0: b"zero0000", 2: b"two22222"}
     assert results[2] == {0: b"zero0000", 1: b"one11111"}
+
+
+def test_p2p_rejects_wrong_job_token():
+    """A message whose header carries a different job token must never be
+    consumed as a peer contribution (ADVICE r4: unauthenticated listener);
+    the exchange completes with the legitimate peer regardless."""
+    import socket
+    import struct
+    import threading
+
+    import pytest
+
+    a, b = _make_transport(0), _make_transport(1)
+    book = [("127.0.0.1", t._listener.getsockname()[1]) for t in (a, b)]
+    a.addrs = b.addrs = book
+
+    intruder_done = threading.Event()
+
+    def intrude():
+        # claims to be rank 0 but with a wrong token
+        s = socket.create_connection(book[1], timeout=10)
+        hdr = struct.pack(a._HEADER, 0, 1, 0xBAD, len(b"evil1234"))
+        s.sendall(hdr + b"evil1234")
+        s.close()
+        intruder_done.set()
+
+    out = {}
+
+    def run(t, payload, key):
+        out[key] = t.exchange(payload, [1 - t.rank])
+
+    threading.Thread(target=intrude).start()
+    assert intruder_done.wait(10)
+    th = threading.Thread(target=run, args=(b, b"beta5678", "b"))
+    try:
+        with pytest.warns(UserWarning, match="bad job token"):
+            th.start()
+            run(a, b"alph1234", "a")
+            th.join(timeout=60)
+    finally:
+        a._listener.close()
+        b._listener.close()
+    assert out["a"] == {1: b"beta5678"}
+    assert out["b"] == {0: b"alph1234"}

@@ -18,14 +18,36 @@ class Kernel:
         self.disabled = True
 
 
-def test_permanent_marker_disables_immediately():
+def test_permanent_marker_disables_on_second_consecutive_hit():
+    """A substring marker can coincidentally appear in a transient
+    error's text, so a marker-classified error must recur on the next
+    call before the fast path is disabled for good (ADVICE r4)."""
     k = Kernel()
 
     def fast():
         raise RuntimeError("Mosaic failed to compile: unsupported op")
 
     assert fallback_call("k", fast, lambda: 1, k.disable) == 1
-    assert k.disabled
+    assert not k.disabled  # first hit: could be a transient coincidence
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert k.disabled      # it recurred: deterministic rejection
+
+
+def test_single_marker_hit_then_success_keeps_the_fast_path():
+    k = Kernel()
+    state = {"fail": True}
+
+    def fast():
+        if state["fail"]:
+            raise RuntimeError("RPC cancelled while lowering in flight")
+        return 42
+
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    state["fail"] = False
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 42
+    state["fail"] = True
+    assert fallback_call("k", fast, lambda: 1, k.disable) == 1
+    assert not k.disabled  # hits were not consecutive: no disable
 
 
 def test_not_implemented_disables_immediately():
