@@ -135,26 +135,31 @@ class GameOfLife:
         put = lambda a: put_table(a, mesh)
         tabs = tuple(put(a) for a in (irows, orows, nri, nvi, nro, nvo))
         local = put(epoch.local_mask)
-        send_rows, recv_rows = halo.send_rows, halo.recv_rows
+        nk = len(halo.ring_ks)
+        perms = halo.ring_perms
         data_spec = P(SHARD_AXIS)
-        idx3 = P(SHARD_AXIS, None, None)
 
         rule = _life_rule
 
         from ..parallel.halo import HaloExchange
 
-        def body(sr, rr, irows, orows, nri, nvi, nro, nvo, local, alive):
+        def body(*args):
+            # args: ring send tabs (nk), ring recv tabs (nk), then the
+            # compute tables and the alive array
+            sends = [a[0] for a in args[:nk]]
+            recvs = [a[0] for a in args[nk:2 * nk]]
+            irows, orows, nri, nvi, nro, nvo, local, alive = args[2 * nk:]
             a = alive[0]                                     # [R]
-            # --- start: ghost payload collective (depends only on `a`)
-            recvd = HaloExchange.gather_payload(a, sr[0])
-            # --- inner compute: no remote neighbors, no dep on `recvd`
+            # --- start: ghost payload collectives (depend only on `a`)
+            payloads = HaloExchange.ring_start(a, perms, sends)
+            # --- inner compute: no remote neighbors, no dep on payloads
             cnt_i = jnp.sum(
                 jnp.where(nvi[0], (a[nri[0]] > 0).astype(jnp.uint32), 0),
                 -1, dtype=jnp.uint32,
             )
             new_i = rule(cnt_i, a[irows[0]])
-            # --- wait: merging the payload IS the synchronization
-            a2 = HaloExchange.merge_payload(a, rr[0], recvd)
+            # --- wait: merging the payloads IS the synchronization
+            a2 = HaloExchange.ring_finish(a, recvs, payloads)
             # --- outer compute: needs fresh ghosts
             cnt_o = jnp.sum(
                 jnp.where(nvo[0], (a2[nro[0]] > 0).astype(jnp.uint32), 0),
@@ -172,7 +177,8 @@ class GameOfLife:
         fn = shard_map(
             body,
             mesh=mesh,
-            in_specs=(idx3, idx3) + (P(SHARD_AXIS, None),) * 2
+            in_specs=(P(SHARD_AXIS, None),) * (2 * nk)
+            + (P(SHARD_AXIS, None),) * 2
             + (P(SHARD_AXIS, None, None),) * 4 + (P(SHARD_AXIS, None), data_spec),
             out_specs=(data_spec, data_spec),
             check_vma=False,
@@ -181,7 +187,8 @@ class GameOfLife:
         @jax.jit
         def step(state):
             out_a, cnt = fn(
-                send_rows, recv_rows, *tabs, local, state["is_alive"]
+                *halo.ring_send, *halo.ring_recv, *tabs, local,
+                state["is_alive"],
             )
             return {"is_alive": out_a, "live_neighbor_count": cnt}
 

@@ -119,6 +119,37 @@ def test_send_receive_counts_symmetric():
     assert total_send == total_recv == int(h.pair_counts.sum())
 
 
+def test_ring_schedule_wire_bytes_scale_with_actual_pairs():
+    """VERDICT-r4 weak 5: the general halo must not be a padded
+    worst-pair x D^2 all_to_all.  The ring schedule only runs the
+    distances some pair actually communicates over, each sized by its
+    own max pair count, so on a slab-partitioned grid the wire traffic
+    tracks the real send lists (reference neighbor-only messaging,
+    dccrg.hpp:10564-11070)."""
+    g = make_grid(length=(8, 8, 8), hood=1)
+    h = g.epoch.hoods[None]
+    halo = g.halo(None)
+    D = g.n_devices
+    pc = np.asarray(h.pair_counts)
+    dd = np.arange(D)
+    # the schedule covers exactly the distances with traffic
+    active = {k for k in range(1, D) if pc[dd, (dd + k) % D].max() > 0}
+    assert set(halo.ring_ks) == active
+    # wire rows = sum over active distances of D * that distance's max
+    want_wire = sum(int(pc[dd, (dd + k) % D].max()) * D for k in active)
+    assert halo.wire_cells == want_wire
+    # a z-ordered 8x8x8 grid on 8 devices is slab-like: nearest-distance
+    # traffic dominates, so the ring moves far less than the padded
+    # all_to_all equivalent (D * D * global max) and stays within 2x of
+    # the useful payload
+    padded_equiv = D * D * int(pc.max())
+    assert halo.wire_cells < padded_equiv
+    assert halo.wire_cells <= 2 * halo.cells_moved
+    state = g.new_state({"v": ((), np.float64)})
+    assert halo.wire_bytes(state) == halo.wire_cells * 8
+    assert halo.bytes_moved(state) == halo.cells_moved * 8
+
+
 def test_face_neighbors():
     g = make_grid(length=(3, 3, 3), hood=1)
     # center cell 14: 6 face neighbors
