@@ -86,7 +86,11 @@ class Grid:
 
     def set_load_balancing_method(self, method: str) -> "Grid":
         self._assert_uninitialized()
-        self._lb_method = str(method)
+        # normalized once here: compute_partition upper-cases anyway, and
+        # initialize's striping dispatch compares verbatim — a lowercase
+        # method must not stripe differently from its uppercase spelling
+        # (it would also defeat the multi-controller agreement digest)
+        self._lb_method = str(method).upper()
         return self
 
     def set_geometry(self, factory=None, **params) -> "Grid":
@@ -144,6 +148,22 @@ class Grid:
         else:
             n0 = int(np.prod(self._length))
             cells = np.arange(1, n0 + 1, dtype=np.uint64)
+        # enforced multi-controller agreement on the builder inputs: a
+        # controller whose settings diverge would build a different grid
+        # and silently desynchronize every later collective; raise on all
+        # controllers instead (no-op with one controller)
+        from .utils.collectives import assert_agreement
+
+        settings = repr((
+            self._length, self._max_ref_lvl, self._periodic,
+            self._hood_length, str(self._lb_method).upper(),
+            type(self.geometry).__name__,
+        )).encode()
+        assert_agreement(
+            "Grid.initialize settings",
+            settings + self.geometry.params_to_file_bytes()
+            + (cells.tobytes() if leaf_set is not None else b""),
+        )
         if self._lb_method in ("HSFC", "SFC", "HILBERT"):
             owner = hilbert_partition(self.mapping, cells, self.n_devices)
         elif self._lb_method == "MORTON":
@@ -518,6 +538,15 @@ class Grid:
         payload layouts) are unchanged; existing states remain valid."""
         self._assert_no_staged_lb()
         self._assert_initialized()
+        # enforced agreement BEFORE any early-out: every controller must
+        # attempt the same registration or all of them fail loudly
+        from .utils.collectives import assert_agreement
+
+        assert_agreement(
+            f"add_neighborhood({hood_id})",
+            np.int64(-1 if hood_id is None else hood_id).tobytes()
+            + np.asarray(offsets, dtype=np.int64).tobytes(),
+        )
         if hood_id in self.neighborhoods or hood_id is None:
             return False
         offs = validate_neighborhood(offsets)
@@ -534,6 +563,12 @@ class Grid:
         return True
 
     def remove_neighborhood(self, hood_id: int) -> bool:
+        from .utils.collectives import assert_agreement
+
+        assert_agreement(
+            f"remove_neighborhood({hood_id})",
+            np.int64(-1 if hood_id is None else hood_id).tobytes(),
+        )
         if hood_id is None or hood_id not in self.neighborhoods:
             return False
         del self.neighborhoods[hood_id]

@@ -294,6 +294,30 @@ def main() -> None:
         "pos_hash": _hash(np.sort(pic.positions(sp2), axis=0).round(12)),
     }
 
+    # ---- scenario 9: enforced agreement for host mutators ------------
+    # user-neighborhood registration and builder settings are checked
+    # (hash-compared over the collectives seam), not just documented:
+    # a deliberately diverging registration must raise on EVERY
+    # controller, leaving no mutation behind; an agreeing one succeeds.
+    try:
+        grid.add_neighborhood(99, [(0, 0, 1)] if pid == 0 else [(0, 1, 0)])
+        agreement_nbhood = "missed"
+    except RuntimeError as e:
+        agreement_nbhood = "raised" if "disagree" in str(e) else f"wrong:{e}"
+    assert 99 not in grid.neighborhoods, "diverging hood must not register"
+    assert grid.add_neighborhood(5, [(0, 1, 0)]), "agreeing hood must land"
+    assert grid.remove_neighborhood(5)
+    try:
+        (Grid()
+         .set_initial_length((4 + pid, 4, 1))     # diverging builder input
+         .set_neighborhood_length(1)
+         .initialize(mesh=make_mesh()))
+        agreement_init = "missed"
+    except RuntimeError as e:
+        agreement_init = "raised" if "disagree" in str(e) else f"wrong:{e}"
+    res["agreement"] = {"neighborhood": agreement_nbhood,
+                       "initialize": agreement_init}
+
     print("RESULT " + json.dumps(res), flush=True)
 
 
