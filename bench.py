@@ -40,6 +40,9 @@ NX, NY, NZ = 128, 128, 64
 STEPS = 5000
 REFINED_N = 48          # 48^3 level-0, ball refined -> ~198k cells, 2 levels
 REFINED_STEPS = 2000
+REFINED3_N = 16         # 16^3 level-0, broad ball refined twice -> 3 levels
+REFINED3_STEPS = 1000
+REFINED3_RADII = (0.6, 0.55)  # deep refinement over most of the domain
 LARGE = (512, 512, 128)  # f32 density alone is 128 MiB: cannot fit VMEM
 LARGE_STEPS = 200
 GOL_N = 500              # the reference example's board (game_of_life.cpp)
@@ -196,6 +199,82 @@ def measure_refined(force: str | None = None) -> dict:
                          for b in adv.boxed.boxes.values()),
         "flat_n_vox": int(getattr(adv, "_flat_n_vox", 0)),
         "updates_per_s": n_cells * REFINED_STEPS / secs,
+        "secs": secs,
+        "times": [round(t, 4) for t in times],
+    }
+
+
+def measure_refined3(force: str | None = None) -> dict:
+    """Three-level AMR grid (VERDICT-r4 item 5's 'done' config): ball
+    refined twice, comparing the multi-level flat XLA whole-run
+    (``ops/flat_amr.build_flat_ml_tables``) against the boxed per-level
+    passes on the reference's deep-AMR regime
+    (``dccrg_mapping.hpp:316-329`` allows 21 levels).
+
+    ``force``: None lets the cost edge choose; "ml"/"boxed" pin the
+    path so each side is measured directly."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Advection
+
+    n = REFINED3_N
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(2)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    for rad in REFINED3_RADII:
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        r = np.linalg.norm(c - 0.5, axis=1)
+        lv = g.mapping.get_refinement_level(ids)
+        for cid in ids[(r < rad) & (lv == lv.max())]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    ids = g.get_cells()
+    n_cells = len(ids)
+    levels = sorted(
+        int(v) for v in np.unique(g.mapping.get_refinement_level(ids))
+    )
+
+    adv = Advection(g, dtype=np.float32, allow_dense=False)
+    state = adv.initialize_state()
+    dt = np.float32(0.4 * adv.max_time_step(state))
+    steps = REFINED3_STEPS
+    if force == "ml":
+        assert adv._flat_kind == "ml", adv._flat_kind
+        runner = lambda: adv._flat_run(state, steps, dt)  # noqa: E731
+        path = "ml"
+    elif force == "boxed":
+        assert adv.boxed is not None
+        adv._prefer_boxed = True
+        runner = lambda: adv.run(state, steps, dt)        # noqa: E731
+        path = "boxed"
+    else:
+        runner = lambda: adv.run(state, steps, dt)        # noqa: E731
+        path = ("boxed" if getattr(adv, "_prefer_boxed", False)
+                else adv._flat_kind or "general")
+    jax.block_until_ready(runner())
+    secs, times, _ = _median_of(runner, n=5)
+    return {
+        "n_cells": n_cells,
+        "levels": levels,
+        "path": path,
+        "flat_n_vox": int(getattr(adv, "_flat_n_vox", 0)),
+        "boxed_vol": (sum(int(np.prod(b.shape))
+                          for b in adv.boxed.boxes.values())
+                      if adv.boxed is not None else 0),
+        "updates_per_s": n_cells * steps / secs,
         "secs": secs,
         "times": [round(t, 4) for t in times],
     }
@@ -966,7 +1045,9 @@ def _emit_fallback(diag):
 def _main_real():
     tpu = measure_tpu()
     extras = {}
-    for name, fn in (("refined", measure_refined), ("large", measure_large),
+    for name, fn in (("refined", measure_refined),
+                     ("refined3", measure_refined3),
+                     ("large", measure_large),
                      ("gol", measure_gol), ("pic", measure_pic),
                      ("poisson", measure_poisson), ("vlasov", measure_vlasov),
                      ("multidev_cpu", measure_multidev_cpu)):
@@ -1019,6 +1100,15 @@ def _main_real():
             "updates_per_s": round(ref["updates_per_s"], 1),
             "vs_baseline": round(ref["updates_per_s"] / cpu, 3) if cpu else -1,
             "times_s": ref.get("times"),
+        }
+    if extras.get("refined3"):
+        r3 = extras["refined3"]
+        detail["refined3"] = {
+            **{k: r3[k] for k in ("n_cells", "levels", "path",
+                                  "flat_n_vox", "boxed_vol")},
+            "updates_per_s": round(r3["updates_per_s"], 1),
+            "vs_baseline": round(r3["updates_per_s"] / cpu, 3) if cpu else -1,
+            "times_s": r3.get("times"),
         }
     if extras.get("large"):
         lg = extras["large"]

@@ -57,6 +57,8 @@ __all__ = [
     "flat_voxel_layout",
     "build_flat_amr_sharded",
     "make_flat_amr_run_sharded",
+    "build_flat_ml_tables",
+    "make_flat_ml_run",
     "pad_lane_extent",
 ]
 
@@ -95,18 +97,19 @@ def pad_lane_extent(nx1: int, max_factor: float = 1.5) -> int:
 
 
 def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
-                      allow_multi_device=False):
+                      allow_multi_device=False, max_vl=1):
     """The shared flat voxel layout, or None if the grid does not qualify
-    (Cartesian, leaf levels ⊆ {0, 1}; single device unless
+    (Cartesian, leaf levels ⊆ [0, max_vl]; single device unless
     ``allow_multi_device`` and the ownership equals the voxel z-slab
     partition with coarse blocks never straddling slabs).
 
     Returns a dict:
       shape        (nzv, nyv, nxv) voxel grid at max-leaf-level resolution
-      vox_level    0 (uniform) or 1
+      vox_level    max leaf level (0 = uniform)
       n_devices    D
-      leaf_idx     (n_vox,) int32 global leaf index per voxel (coarse
-                   leaves replicated over their 2x2x2 block)
+      leaf_idx     (n_vox,) int32 global leaf index per voxel (coarser
+                   leaves replicated over their 2^d x 2^d x 2^d block)
+      leaf_level   (nzv, nyv, nxv) int32 — owning leaf's refinement level
       leaf_fine    (nzv, nyv, nxv) bool — voxel is a max-level leaf
       rows         D == 1: (n_vox,) int32 epoch row per voxel;
                    D > 1:  (D, n_vox_loc) int32 per-device epoch rows of
@@ -131,7 +134,7 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
         return None
     lvl = mapping.get_refinement_level(leaves.cells).astype(np.int64)
     vl = int(lvl.max())
-    if vl > 1 or (vl == 0 and not allow_uniform):
+    if vl > max_vl or (vl == 0 and not allow_uniform):
         return None
     L = mapping.max_refinement_level
     nxv, nyv, nzv = (int(v) << vl for v in mapping.length)
@@ -147,25 +150,32 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
         if nzv % D != 0:
             return None
         slab = nzv // D
-        if vl == 1 and slab % 2 != 0:
+        if vl > 0 and slab % (1 << vl) != 0:
             return None  # coarse blocks would straddle slab boundaries
         owner_expected = (vox[:, 2] // slab).astype(leaves.owner.dtype)
         if not np.array_equal(leaves.owner, owner_expected):
             return None
 
     leaf_idx = np.zeros(n_vox, dtype=np.int32)
+    leaf_level = np.zeros(n_vox, dtype=np.int32)
     leaf_fine = np.zeros(n_vox, dtype=bool)
     fine = lvl == vl
     lin = np.arange(N, dtype=np.int32)
     leaf_idx[flat0[fine]] = lin[fine]
+    leaf_level[flat0[fine]] = vl
     leaf_fine[flat0[fine]] = True
-    coarse = np.flatnonzero(~fine)
-    if len(coarse):
-        for dz in range(2):
-            for dy in range(2):
-                for dx in range(2):
-                    off = (dz * nyv + dy) * nxv + dx
-                    leaf_idx[flat0[coarse] + off] = lin[coarse]
+    for l in range(vl):
+        sel = np.flatnonzero(lvl == l)
+        if not len(sel):
+            continue
+        B = 1 << (vl - l)
+        dz, dy, dx = np.meshgrid(
+            np.arange(B), np.arange(B), np.arange(B), indexing="ij"
+        )
+        off = ((dz.ravel() * nyv + dy.ravel()) * nxv + dx.ravel())
+        tgt = flat0[sel][:, None] + off[None, :]
+        leaf_idx[tgt] = lin[sel][:, None]
+        leaf_level[tgt] = l
 
     R = epoch.R
     row_of = epoch.row_of
@@ -193,6 +203,7 @@ def flat_voxel_layout(grid, allow_uniform=False, max_voxels=None,
         vox_level=vl,
         n_devices=D,
         leaf_idx=leaf_idx,
+        leaf_level=leaf_level.reshape(nzv, nyv, nxv),
         leaf_fine=leaf_fine.reshape(nzv, nyv, nxv),
         rows=rows,
         wb_rows=wb_rows,
@@ -624,6 +635,317 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
     # devices is rejected by JAX
     statics = tuple(put_table(tables[k], mesh) for k in
                     ("rows", "leaf_fine", "leaf_ext", "wb_rows", "wb_valid"))
+
+    @jax.jit
+    def run_fn(state, steps, dt):
+        rho = sm(
+            *statics,
+            state["density"], state["vx"], state["vy"], state["vz"],
+            jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
+        )
+        return {
+            **state,
+            "density": rho.astype(state["density"].dtype),
+            "flux": jnp.zeros_like(state["flux"]),
+        }
+
+    return run_fn
+
+
+# --------------------------------------------------------- multi-level
+
+#: deepest leaf level the multi-level flat scheme inflates to: 8^4 voxel
+#: inflation of a level-0 leaf is already past any sensible budget, and
+#: the reference's own AMR workloads live at 2-4 levels
+_ML_MAX_VL = 4
+
+
+def build_flat_ml_tables(grid):
+    """Multi-level flat layout (3+ leaf levels) for the XLA whole-run
+    form, or None when the grid does not qualify — the VERDICT-r4
+    extension of the two-level flat scheme past levels {0, 1}
+    (reference AMR allows 21 levels, ``dccrg_mapping.hpp:316-329``).
+
+    Same inflated-voxel idea as the two-level scheme: every leaf is
+    replicated over its 2^d-cube of finest-level voxels, faces become
+    voxel pairs with the reference's length-weighted face velocities
+    (adjacent leaves differ by at most one level under 2:1 balance, so
+    the two-point mix covers every face), and each coarse leaf's update
+    is the block sum of its voxel deltas over its own volume.  The
+    block sums run down a reshape pyramid (one 2x2x2 reduction per
+    level doubling — contiguous reductions, far cheaper than shifted
+    copies), each level's leaves are captured at their own reduced
+    resolution, and the accumulated coarse updates broadcast back up
+    one doubling at a time — so the whole multi-step run stays one
+    fused XLA dispatch (single device or z-slab sharded; slabs hold
+    whole coarse blocks so pooling is collective-free)."""
+    epoch = grid.epoch
+    D = epoch.n_devices
+    if len(epoch.leaves) == 0:
+        return None
+    # cheap level screen BEFORE the O(n_vox) layout build: the tuned
+    # two-level paths own levels {0, 1}, so a 2-level grid must not pay
+    # for (and then discard) the inflated layout here
+    vl = int(
+        epoch.mapping.get_refinement_level(epoch.leaves.cells).max()
+    )
+    if vl < 2:
+        return None
+    lay = flat_voxel_layout(grid, allow_uniform=False,
+                            allow_multi_device=True, max_vl=_ML_MAX_VL)
+    if lay is None:
+        return None
+    nzv, nyv, nxv = lay["shape"]
+    nzl = nzv // D
+    n_vox = nzv * nyv * nxv
+    N = len(epoch.leaves)
+    # cost guards: inflation within a modest factor of the real leaf
+    # count, per-device residency within HBM comfort
+    if n_vox > max(16 * N, 1 << 22):
+        return None
+    if 14 * (n_vox // D) * 4 > (2 << 30):
+        return None
+
+    lev = lay["leaf_level"]                         # (nzv, nyv, nxv)
+    lidx = lay["leaf_idx"].reshape(nzv, nyv, nxv)
+
+    def ringed(a):
+        """Per-device slab with the z-neighbor devices' edge planes."""
+        return np.stack([
+            np.concatenate([
+                a[(d * nzl - 1) % nzv][None],
+                a[d * nzl:(d + 1) * nzl],
+                a[((d + 1) * nzl) % nzv][None],
+            ])
+            for d in range(D)
+        ])
+
+    rows = lay["rows"]
+    wb_rows, wb_valid = lay["wb_rows"], lay["wb_valid"]
+    if D == 1:
+        rows = rows[None, :]
+        wb_rows = wb_rows[None, :]
+        wb_valid = wb_valid[None, :]
+
+    l0 = np.asarray(grid.geometry.get_level_0_cell_length(), np.float64)
+    lf = l0 / (1 << vl)                             # finest cell lengths
+    vol_f = float(lf.prod())
+
+    # static per-voxel update tables (slab-local)
+    lev_loc = lev.reshape(D, nzl, nyv, nxv)
+    # volume tables in f64: the run casts them to ITS dtype, so an f64
+    # run must not inherit f32-quantized inverse volumes (the lf.prod()
+    # is a power of two only for power-of-two domain lengths)
+    updf = (lev_loc == vl).astype(np.float64) / vol_f
+    pool = (lev_loc < vl).astype(np.float64)
+    # per-level capture masks at the REDUCED resolution of that level's
+    # blocks: the run pools delta down a reshape pyramid, so level
+    # vl-1-k's leaves are read at stride 2^(k+1) — a stride-f origin
+    # whose leaf level equals l marks exactly that leaf's block (leaves
+    # of level l are always aligned to their own block size)
+    caps = []
+    for k in range(vl):
+        l = vl - 1 - k
+        f = 1 << (k + 1)
+        lev_red = lev_loc[:, ::f, ::f, ::f]
+        inv_vol = 1.0 / (vol_f * float(8 ** (k + 1)))
+        caps.append((lev_red == l).astype(np.float64) * inv_vol)
+
+    return dict(
+        shape=(nzl, nyv, nxv),
+        vl=vl,
+        n_devices=D,
+        rows=rows,
+        wb_rows=wb_rows,
+        wb_valid=wb_valid,
+        lev=lev_loc,
+        lev_ext=ringed(lev),
+        lidx=lidx.reshape(D, nzl, nyv, nxv),
+        lidx_ext=ringed(lidx),
+        updf=updf,
+        pool=pool,
+        caps=caps,
+        cap_active=[bool(c.any()) for c in caps],
+        area_f=np.array([lf[1] * lf[2], lf[0] * lf[2], lf[0] * lf[1]]),
+        periodic=tuple(bool(grid.topology.is_periodic(d)) for d in range(3)),
+        n_vox=n_vox,
+    )
+
+
+def _face_weights_ml(va, vb, la, lb, ia, ib, area_d, dtype, extra_invalid):
+    """Signed upwind weight pair for voxel faces pairing (a, b) planes in
+    the multi-level scheme: the reference's length-weighted face velocity
+    (``solve.hpp:168-175``; 2:1 balance keeps level differences <= 1 so
+    the two-point mix is exact), intra-leaf pairs (same leaf id on both
+    sides) carry no face."""
+    third = dtype(1.0 / 3.0)
+    vface = jnp.where(
+        la == lb,
+        dtype(0.5) * (va + vb),
+        jnp.where(
+            la > lb,                      # a finer than b
+            (dtype(2.0) * va + vb) * third,
+            (va + dtype(2.0) * vb) * third,
+        ),
+    )
+    valid = ia != ib
+    if extra_invalid is not None:
+        valid = valid & ~extra_invalid
+    w = jnp.where(valid, vface * dtype(area_d), dtype(0.0))
+    wp = jnp.where(vface >= 0, w, dtype(0.0))
+    return wp, w - wp
+
+
+def make_flat_ml_run(grid, tables, dtype=jnp.float32):
+    """The jitted multi-level flat run: one shard_map (D >= 1) around the
+    whole fori_loop; per step two ppermuted voxel planes, one weighted
+    flux pass, and the reshape-pyramid pool/broadcast for the
+    coarse-leaf updates."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.dense import HaloExtend
+    from ..parallel.mesh import SHARD_AXIS, put_table
+
+    nzl, nyv, nxv = tables["shape"]
+    D = tables["n_devices"]
+    vl = tables["vl"]
+    px, py, pz = tables["periodic"]
+    area = tables["area_f"]
+    cap_active = tables["cap_active"]
+    # pooling only needs to reach the coarsest level actually present
+    kmax = max((k for k in range(vl) if cap_active[k]), default=-1)
+    mesh = grid.mesh
+    ring = HaloExtend(D)
+
+    def body(rows, lev, lev_ext, lidx, lidx_ext, updf, pool, *rest):
+        caps = [c[0] for c in rest[:vl]]
+        wbr, wbv = rest[vl][0], rest[vl + 1][0]
+        rho_rows, vx_rows, vy_rows, vz_rows, dt, steps = rest[vl + 2:]
+        rows, lev, lev_ext = rows[0], lev[0], lev_ext[0]
+        lidx, lidx_ext = lidx[0], lidx_ext[0]
+        updf, pool = updf[0], pool[0]
+        dev = jax.lax.axis_index(SHARD_AXIS)
+
+        def field(arr_rows):
+            return arr_rows[0][rows].reshape(nzl, nyv, nxv).astype(dtype)
+
+        V = field(rho_rows)
+        VX, VY, VZ = field(vx_rows), field(vy_rows), field(vz_rows)
+
+        # ---- x/y face weights (full extents locally; rolls = wrap)
+        w_xy = []
+        for d2, vel, n in ((0, VX, nxv), (1, VY, nyv)):
+            ax = 2 - d2
+            pos = jax.lax.broadcasted_iota(jnp.int32, (nzl, nyv, nxv), ax)
+            periodic_d = px if d2 == 0 else py
+            extra = None if periodic_d else (pos == n - 1)
+            w_xy.append(_face_weights_ml(
+                vel, jnp.roll(vel, -1, ax),
+                lev, jnp.roll(lev, -1, ax),
+                lidx, jnp.roll(lidx, -1, ax),
+                area[d2], dtype, extra,
+            ))
+        (wpx, wnx), (wpy, wny) = w_xy
+
+        # ---- z weights on the nzl+1 ringed faces (global face index
+        # dev*nzl - 1 + j for the non-periodic mask)
+        below_v, above_v = ring.planes(VZ)
+        VZe = jnp.concatenate([below_v, VZ, above_v], axis=0)
+        gface = (
+            dev * nzl - 1
+            + jax.lax.broadcasted_iota(jnp.int32, (nzl + 1, nyv, nxv), 0)
+        )
+        extra_z = (
+            None if pz else (gface == -1) | (gface == D * nzl - 1)
+        )
+        wzp, wzn = _face_weights_ml(
+            VZe[:-1], VZe[1:], lev_ext[:-1], lev_ext[1:],
+            lidx_ext[:-1], lidx_ext[1:], area[2], dtype, extra_z,
+        )
+
+        dtc = jnp.asarray(dt, dtype)
+        wpx, wnx = wpx * dtc, wnx * dtc
+        wpy, wny = wpy * dtc, wny * dtc
+        wzp, wzn = wzp * dtc, wzn * dtc
+        updf_c = updf.astype(dtype)
+        pool_c = pool.astype(dtype)
+        caps_c = [c.astype(dtype) for c in caps]
+
+        def down2(a):
+            nz_, ny_, nx_ = a.shape
+            return a.reshape(
+                nz_ // 2, 2, ny_ // 2, 2, nx_ // 2, 2
+            ).sum(axis=(1, 3, 5))
+
+        def up2(a):
+            nz_, ny_, nx_ = a.shape
+            return jnp.broadcast_to(
+                a[:, None, :, None, :, None], (nz_, 2, ny_, 2, nx_, 2)
+            ).reshape(nz_ * 2, ny_ * 2, nx_ * 2)
+
+        def one(i, Vc):
+            fx = Vc * wpx + jnp.roll(Vc, -1, 2) * wnx
+            fy = Vc * wpy + jnp.roll(Vc, -1, 1) * wny
+            below, above = ring.planes(Vc)
+            Ve = jnp.concatenate([below, Vc, above], axis=0)
+            fz_faces = Ve[:-1] * wzp + Ve[1:] * wzn      # (nzl+1, ...)
+            delta = jnp.roll(fx, 1, 2) - fx
+            delta = delta + jnp.roll(fy, 1, 1) - fy
+            delta = delta + fz_faces[:-1] - fz_faces[1:]
+            out_add = delta * updf_c
+            if kmax >= 0:
+                # reshape pyramid: pooling level k holds exact 2^(k+1)
+                # block sums (blocks never straddle slabs since
+                # slab % 2^vl == 0); each level's leaves are captured at
+                # their own resolution (inv volume folded into the mask)
+                # and the accumulated coarse updates are broadcast back
+                # up one doubling at a time
+                subs = []
+                cur = delta * pool_c
+                for _k in range(kmax + 1):
+                    cur = down2(cur)
+                    subs.append(cur)
+                acc = None
+                for k in range(kmax, -1, -1):
+                    if acc is not None:
+                        acc = up2(acc)
+                    if cap_active[k]:
+                        contrib = subs[k] * caps_c[k]
+                        acc = contrib if acc is None else acc + contrib
+                if acc is not None:
+                    out_add = out_add + up2(acc)
+            return Vc + out_add
+
+        out = jax.lax.fori_loop(0, steps, one, V)
+        rho = jnp.where(wbv, out.reshape(-1)[wbr], rho_rows[0])
+        return rho[None]
+
+    data_spec = P(SHARD_AXIS)
+    spec2 = P(SHARD_AXIS, None)
+    spec4 = P(SHARD_AXIS, None, None, None)
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec2,) + (spec4,) * 6 + (spec4,) * vl + (spec2, spec2)
+        + (data_spec,) * 4 + (P(), P()),
+        out_specs=data_spec,
+        check_vma=False,
+    )
+
+    statics = (
+        put_table(tables["rows"], mesh),
+        put_table(tables["lev"], mesh),
+        put_table(tables["lev_ext"], mesh),
+        put_table(tables["lidx"], mesh),
+        put_table(tables["lidx_ext"], mesh),
+        # volume tables shipped in the RUN dtype (stored f64 so an f64
+        # run never sees f32-quantized inverse volumes)
+        put_table(tables["updf"], mesh, dtype),
+        put_table(tables["pool"], mesh, dtype),
+        *(put_table(c, mesh, dtype) for c in tables["caps"]),
+        put_table(tables["wb_rows"], mesh),
+        put_table(tables["wb_valid"], mesh),
+    )
 
     @jax.jit
     def run_fn(state, steps, dt):

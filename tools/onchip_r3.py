@@ -107,6 +107,13 @@ MEASUREMENTS = {
     "refined_boxed": (
         "import bench\n"
         "print(json.dumps(bench.measure_refined(force='boxed')))", 1500),
+    # the 3-level config, both paths pinned + the dispatch's own choice
+    "refined3_ml": (
+        "import bench\n"
+        "print(json.dumps(bench.measure_refined3(force='ml')))", 1500),
+    "refined3_boxed": (
+        "import bench\n"
+        "print(json.dumps(bench.measure_refined3(force='boxed')))", 1500),
     "pic": ("import bench\nprint(json.dumps(bench.measure_pic()))", 1500),
     "poisson": ("import bench\nprint(json.dumps(bench.measure_poisson()))",
                 1500),

@@ -142,6 +142,15 @@ def one(seed):
     rb = np.asarray(adv.get_cell_data(b, 'density', ids), np.float64)
     err = np.abs(rb - ref).max() / np.abs(ref).max()
     assert err < 5e-6, (seed, n, n_dev, periodic, err)
+    # multi-level flat path (when the layout qualifies): same state,
+    # same oracle
+    adv_ml = Advection(g, dtype=np.float32)
+    if getattr(adv_ml, '_flat_kind', None) == 'ml':
+        m = adv_ml._flat_run(s0, jnp.asarray(3, jnp.int32), dt)
+        rm = np.asarray(adv_ml.get_cell_data(m, 'density', ids), np.float64)
+        errm = np.abs(rm - ref).max() / np.abs(ref).max()
+        assert errm < 5e-6, (seed, 'ml', n, n_dev, periodic, errm)
+        return '3lvl-ml-ok'
     return '3lvl-ok'
 
 import collections
