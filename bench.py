@@ -564,6 +564,69 @@ def measure_poisson(allow_flat: bool = True, use_pallas: bool = True,
     return out
 
 
+def measure_poisson3() -> dict:
+    """Three-level Poisson on the flat multi-level operator (VERDICT-r4
+    item 3: multi-level solves must not fall to the gather path)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Poisson
+
+    n = 16
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(2)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    for rad in (0.35, 0.25):
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        r = np.linalg.norm(c - 0.5, axis=1)
+        lv = g.mapping.get_refinement_level(ids)
+        for cid in ids[(r < rad) & (lv == lv.max())]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+    rhs -= rhs.mean()
+    p = Poisson(g, dtype=np.float32)
+    assert p._flat is not None, "3-level grid must stay on the flat path"
+    assert p._flat_tables["vl"] == 2
+    state = p.initialize_state(rhs)
+    iters = 60
+    jax.block_until_ready(p.solve(state, max_iterations=2,
+                                  stop_residual=0.0)[0]["solution"])
+
+    def one():
+        out, _res, it = p.solve(state, max_iterations=iters,
+                                stop_residual=0.0,
+                                stop_after_residual_increase=float("inf"))
+        return out["solution"], it
+
+    secs, times, (_, it_ran) = _median_of(one, n=3)
+    it_ran = max(int(it_ran), 1)
+    n_cells = len(ids)
+    return {
+        "n_cells": n_cells,
+        "levels": sorted(int(v) for v in np.unique(
+            g.mapping.get_refinement_level(ids))),
+        "iterations": it_ran,
+        "path": "flat_ml",
+        "cell_iterations_per_s": n_cells * it_ran / secs,
+        "times_s": [round(t, 4) for t in times],
+    }
+
+
 def measure_vlasov() -> dict:
     """BASELINE.md config 5 (Vlasiator stretch): 6-D Vlasov — a velocity
     block per spatial cell; reports phase-space cell-updates/s."""
@@ -761,6 +824,39 @@ print("BENCH_JSON:" + json.dumps(r8))
               file=sys.stderr)
     except Exception as e:  # noqa: BLE001 - report, never kill the bench
         print(f"multidev bench failed: {e}", file=sys.stderr)
+    return None
+
+
+def measure_scalability() -> dict | None:
+    """1/2/4/8-virtual-device sweep (advection + GoL) — the analogue of
+    the reference's scalability sweep logs
+    (``tests/scalability/run_tests.py:27-39``), reporting cells/s and
+    halo GB/s per device count.  Subprocess: the virtual CPU mesh must
+    not contaminate this process's accelerator backend."""
+    code = r"""
+import json, sys
+sys.path.insert(0, %r)
+from benchmarks.scalability import run_sweep
+out = {
+    "advection": run_sweep("advection", [1, 2, 4, 8], 64, 50),
+    "gol": run_sweep("gol", [1, 2, 4, 8], 256, 50),
+}
+print("SCAL_JSON:" + json.dumps(out))
+""" % str(ROOT)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=1200,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("SCAL_JSON:"):
+                return json.loads(line[len("SCAL_JSON:"):])
+        print(f"scalability sweep produced no result: {r.stderr[-1000:]}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - report, never kill the bench
+        print(f"scalability sweep failed: {e}", file=sys.stderr)
     return None
 
 
@@ -1015,6 +1111,16 @@ def _emit_fallback(diag):
                         "the tunnel (tools/onchip_r3.py --watch measures "
                         "incrementally whenever it comes up)",
             },
+            "round5_changes_unmeasured_on_chip": {
+                "flat_ml_amr": "3+ level flat AMR whole-run (XLA, "
+                    "reshape-pyramid coarse updates); bench.refined3 "
+                    "measures ml vs boxed (battery keys refined3_ml / "
+                    "refined3_boxed)",
+                "ring_halo": "general halo rewritten from padded "
+                    "[D,D,S] all_to_all to per-distance ppermute ring "
+                    "steps sized by actual pair counts; wire bytes now "
+                    "scale with the real send lists",
+            },
             "round4_changes_unmeasured_on_chip": {
                 "advection_blocked_direct": "per-step streaming traffic "
                     "5+8/B -> 5+4/B full arrays (B=4 on the large grid: "
@@ -1038,6 +1144,7 @@ def _emit_fallback(diag):
             },
             "onchip_battery": battery,
             "multidev_cpu": r8,
+            "scalability": measure_scalability(),
         },
     })
 
@@ -1049,8 +1156,11 @@ def _main_real():
                      ("refined3", measure_refined3),
                      ("large", measure_large),
                      ("gol", measure_gol), ("pic", measure_pic),
-                     ("poisson", measure_poisson), ("vlasov", measure_vlasov),
-                     ("multidev_cpu", measure_multidev_cpu)):
+                     ("poisson", measure_poisson),
+                     ("poisson3", measure_poisson3),
+                     ("vlasov", measure_vlasov),
+                     ("multidev_cpu", measure_multidev_cpu),
+                     ("scalability", measure_scalability)):
         try:
             extras[name] = fn()
         except Exception as e:  # noqa: BLE001 - partial results still count
@@ -1121,7 +1231,7 @@ def _main_real():
             "hbm_peak_GBps": lg.get("hbm_peak_GBps"),
             "hbm_fraction_of_peak": lg.get("hbm_fraction_of_peak"),
         }
-    for name in ("poisson", "vlasov", "pic"):
+    for name in ("poisson", "poisson3", "vlasov", "pic"):
         if extras.get(name):
             detail[name] = {
                 k: (round(v, 1) if isinstance(v, float) else v)
@@ -1150,6 +1260,8 @@ def _main_real():
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in extras["multidev_cpu"].items()
         }
+    if extras.get("scalability"):
+        detail["scalability"] = extras["scalability"]
     print(
         json.dumps(
             {

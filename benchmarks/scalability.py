@@ -103,6 +103,14 @@ def run_sweep(workload: str, counts, size: int, turns: int):
             jax.block_until_ready(state)
             secs = time.perf_counter() - t0
             n_cells = size * size * n_dev
+        # halo traffic per count (reference sweep logs report message
+        # volume alongside throughput): useful ghost bytes and actual
+        # wire bytes of the general ring schedule for a one-f32-field
+        # exchange, times the turn count, over the measured wall time
+        halo = grid.halo(None)
+        probe = {"f": np.zeros((n_dev, grid.epoch.R), np.float32)}
+        useful_b = halo.bytes_moved(probe) * turns
+        wire_b = halo.wire_bytes(probe) * turns
         row = {
             "devices": n_dev,
             "cells": n_cells,
@@ -110,6 +118,9 @@ def run_sweep(workload: str, counts, size: int, turns: int):
             "secs": round(secs, 4),
             "cell_updates_per_s": round(n_cells * turns / secs, 1),
             "per_device_per_s": round(n_cells * turns / secs / n_dev, 1),
+            "halo_GBps": round(useful_b / secs / 1e9, 4),
+            "halo_wire_GBps": round(wire_b / secs / 1e9, 4),
+            "ring_distances": len(halo.ring_ks),
         }
         results.append(row)
         print(json.dumps(row))

@@ -494,15 +494,38 @@ class Grid:
 
     # ---------------------------------------------------------------- halo
 
-    def halo(self, hood_id=None) -> HaloExchange:
-        """Compiled exchange schedule for a neighborhood (cached per
-        epoch)."""
+    def set_cell_datatype(self, cell_datatype) -> "Grid":
+        """Per-cell dynamic payload policy — the reference's
+        ``get_mpi_datatype(cell_id, sender, receiver, receiving,
+        neighborhood_id)`` seam (``dccrg_get_cell_datatype.hpp:48-125``),
+        where a *cell* can vary its transferred content per exchange and
+        neighborhood.  ``cell_datatype(field, cell_ids, sender, receiver,
+        hood_id) -> bool mask`` selects which of a pair's cells transfer
+        ``field``; unselected ghost copies simply keep their previous
+        values (exactly the reference's not-included-in-the-datatype
+        behavior).  Evaluated once per epoch at schedule compile — the
+        trace-once analogue of the reference's per-call dispatch — and
+        re-evaluated automatically after AMR/load-balance rebuilds.
+        ``None`` clears the policy."""
         self._assert_initialized()
-        if hood_id not in self._halo_cache:
-            self._halo_cache[hood_id] = HaloExchange(
-                self.epoch, self.epoch.hoods[hood_id], self.mesh
+        self._cell_datatype = cell_datatype
+        self._halo_cache = {}
+        return self
+
+    def halo(self, hood_id=None, cell_datatype=...) -> HaloExchange:
+        """Compiled exchange schedule for a neighborhood (cached per
+        epoch).  ``cell_datatype`` overrides the grid-level policy for
+        this schedule (``...`` = inherit, None = full payloads)."""
+        self._assert_initialized()
+        policy = (getattr(self, "_cell_datatype", None)
+                  if cell_datatype is ... else cell_datatype)
+        key = (hood_id, policy)
+        if key not in self._halo_cache:
+            self._halo_cache[key] = HaloExchange(
+                self.epoch, self.epoch.hoods[hood_id], self.mesh,
+                cell_datatype=policy, hood_id=hood_id,
             )
-        return self._halo_cache[hood_id]
+        return self._halo_cache[key]
 
     def update_copies_of_remote_neighbors(self, state, hood_id=None):
         """Blocking ghost refresh (reference ``dccrg.hpp:966-1000``)."""
@@ -1467,12 +1490,14 @@ class Grid:
 
         return _start(path, spec, ragged=ragged, mesh=mesh, n_devices=n_devices)
 
-    def write_vtk_file(self, path: str, scalars: dict | None = None):
-        """Dump leaf-cell geometry (+ optional scalars) as legacy ASCII VTK
-        (reference ``dccrg.hpp:3298-3370``)."""
+    def write_vtk_file(self, path: str, scalars: dict | None = None,
+                       binary: bool = True):
+        """Dump leaf-cell geometry (+ optional scalars) as legacy VTK
+        (reference ``dccrg.hpp:3298-3370``); BINARY encoding by default,
+        ``binary=False`` for eyeball-readable ASCII."""
         from .io.vtk import write_vtk_file as _vtk
 
-        _vtk(self, path, scalars)
+        _vtk(self, path, scalars, binary=binary)
 
     # -------------------------------------------------------- introspection
 

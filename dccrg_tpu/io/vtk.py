@@ -1,40 +1,82 @@
-"""ASCII VTK (legacy UNSTRUCTURED_GRID) writer for visual inspection —
-the reference's ``write_vtk_file`` (``dccrg.hpp:3298-3370``) plus optional
-per-cell scalar fields (the reference's tests append these by hand)."""
+"""VTK (legacy UNSTRUCTURED_GRID) writer for visual inspection — the
+reference's ``write_vtk_file`` (``dccrg.hpp:3298-3370``) plus optional
+per-cell scalar fields (the reference's tests append these by hand).
+
+Fully vectorized: BINARY mode (the default) writes each section as one
+big-endian byte buffer — a 10M-cell grid lands in a couple of seconds —
+and ASCII mode formats in large C-level ``%``-chunks instead of a
+per-cell Python loop.  Both encodings are part of the legacy VTK format
+and load identically in VisIt/ParaView."""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["write_vtk_file"]
 
+#: cells per ASCII %-format chunk (bounds peak string memory)
+_CHUNK = 65536
 
-def write_vtk_file(grid, path: str, scalars: dict | None = None) -> None:
+
+def _ascii_rows(f, arr_2d, fmt_row: str) -> None:
+    """Write a (n, k) array as n text rows via chunked %-formatting —
+    the whole chunk formats in one C-level call."""
+    n, k = arr_2d.shape
+    for lo in range(0, n, _CHUNK):
+        chunk = arr_2d[lo:lo + _CHUNK]
+        f.write((fmt_row * len(chunk)) % tuple(chunk.ravel()))
+
+
+def write_vtk_file(grid, path: str, scalars: dict | None = None,
+                   binary: bool = True) -> None:
     """Write all leaf cells as hexahedra (voxel cells), with optional
-    ``{name: per-cell values}`` scalar data appended."""
+    ``{name: per-cell values}`` scalar data appended.  ``binary``
+    selects legacy-VTK BINARY encoding (big-endian, the fast path);
+    ``binary=False`` writes ASCII for eyeball inspection."""
     cells = grid.get_cells()
-    mins = grid.geometry.get_min(cells)
-    maxs = grid.geometry.get_max(cells)
+    mins = np.asarray(grid.geometry.get_min(cells), np.float64)
+    maxs = np.asarray(grid.geometry.get_max(cells), np.float64)
     n = len(cells)
 
-    with open(path, "w") as f:
-        f.write("# vtk DataFile Version 2.0\n")
-        f.write("dccrg_tpu grid\n")
-        f.write("ASCII\nDATASET UNSTRUCTURED_GRID\n")
-        f.write(f"POINTS {8 * n} float\n")
-        for lo, hi in zip(mins, maxs):
-            for z in (lo[2], hi[2]):
-                for y in (lo[1], hi[1]):
-                    for x in (lo[0], hi[0]):
-                        f.write(f"{x} {y} {z}\n")
-        f.write(f"CELLS {n} {9 * n}\n")
-        for i in range(n):
-            pts = " ".join(str(8 * i + k) for k in range(8))
-            f.write(f"8 {pts}\n")
-        f.write(f"CELL_TYPES {n}\n")
-        f.write("\n".join(["11"] * n) + "\n")
+    # (n, 8, 3) corner coordinates in VTK voxel order: x fastest, then
+    # y, then z (lo/hi per axis)
+    corners = np.empty((n, 8, 3), np.float64)
+    for k in range(8):
+        corners[:, k, 0] = maxs[:, 0] if k & 1 else mins[:, 0]
+        corners[:, k, 1] = maxs[:, 1] if k & 2 else mins[:, 1]
+        corners[:, k, 2] = maxs[:, 2] if k & 4 else mins[:, 2]
+    conn = np.empty((n, 9), np.int64)
+    conn[:, 0] = 8
+    conn[:, 1:] = 8 * np.arange(n, dtype=np.int64)[:, None] + np.arange(8)
+
+    mode = "wb" if binary else "w"
+    enc = (lambda s: s.encode()) if binary else (lambda s: s)
+    with open(path, mode) as f:
+        f.write(enc("# vtk DataFile Version 2.0\n"))
+        f.write(enc("dccrg_tpu grid\n"))
+        f.write(enc(("BINARY" if binary else "ASCII")
+                    + "\nDATASET UNSTRUCTURED_GRID\n"))
+        f.write(enc(f"POINTS {8 * n} float\n"))
+        if binary:
+            f.write(corners.astype(">f4").tobytes())
+            f.write(enc(f"\nCELLS {n} {9 * n}\n"))
+            f.write(conn.astype(">i4").tobytes())
+            f.write(enc(f"\nCELL_TYPES {n}\n"))
+            f.write(np.full(n, 11, ">i4").tobytes())
+            f.write(enc("\n"))
+        else:
+            _ascii_rows(f, corners.reshape(-1, 3), "%.9g %.9g %.9g\n")
+            f.write(f"CELLS {n} {9 * n}\n")
+            _ascii_rows(f, conn, "%d %d %d %d %d %d %d %d %d\n")
+            f.write(f"CELL_TYPES {n}\n")
+            _ascii_rows(f, np.full((n, 1), 11, np.int64), "%d\n")
         if scalars:
-            f.write(f"CELL_DATA {n}\n")
+            f.write(enc(f"CELL_DATA {n}\n"))
             for name, vals in scalars.items():
-                vals = np.asarray(vals)
-                f.write(f"SCALARS {name} float 1\nLOOKUP_TABLE default\n")
-                f.write("\n".join(str(float(v)) for v in vals) + "\n")
+                vals = np.asarray(vals, np.float64)
+                f.write(enc(f"SCALARS {name} float 1\n"
+                            "LOOKUP_TABLE default\n"))
+                if binary:
+                    f.write(vals.astype(">f4").tobytes())
+                    f.write(enc("\n"))
+                else:
+                    _ascii_rows(f, vals.reshape(-1, 1), "%.9g\n")

@@ -375,6 +375,10 @@ class Poisson:
             not self.use_pallas
             or t is None
             or t["n_devices"] != 1
+            # the whole-solve kernel's pool/broadcast is the 2-level
+            # roll chain; 3+ level grids stay on the XLA flat matvec
+            # (reshape-pyramid accumulation)
+            or t.get("vl", 1) > 1
             or np.dtype(self.dtype) != np.float32
             or not bicg_fits(int(np.prod(t["shape"])))
             or not have_pallas()

@@ -56,16 +56,17 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
     ``f_pos``/``f_neg``: (N, 3) per-leaf per-axis side factors;
     ``scaling_leaf``: (N,) diagonal; ``types_leaf``: (N,) cell roles.
     """
-    from .flat_amr import flat_voxel_layout
+    from .flat_amr import _ML_MAX_VL, flat_voxel_layout
 
     lay = flat_voxel_layout(
         grid, allow_uniform=True, max_voxels=_MAX_VOXELS,
-        allow_multi_device=True,
+        allow_multi_device=True, max_vl=_ML_MAX_VL,
     )
     if lay is None:
         return None
     shape = lay["shape"]
     leaf_idx = lay["leaf_idx"]
+    vl = int(lay["vox_level"])
 
     t_vox = np.asarray(types_leaf)[leaf_idx]
     f_pos_vox = np.asarray(f_pos)[leaf_idx]        # (n_vox, 3)
@@ -75,8 +76,15 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
     nz1, ny1, nx1 = shape
     rows3 = leaf_idx.reshape(shape)   # same-leaf face detection
     fine3 = lay["leaf_fine"]
+    lev3 = lay["leaf_level"]
     t3 = t_vox.reshape(shape)
-    sub = np.where(fine3, 1.0, 0.25)   # coarse faces span 4 voxel sub-faces
+    # a level-l leaf's face spans 4^(vl-l) voxel sub-faces, so its
+    # per-voxel face weight is f / 4^(vl-l) and the leaf-block sum
+    # restores exactly the reference's factors: full f toward same or
+    # coarser neighbors, f/4 toward each finer face neighbor
+    # (poisson_solve.hpp:332-336) — at any level spread (2:1 balance
+    # keeps adjacent leaves within one level)
+    sub = 0.25 ** (vl - lev3).astype(np.float64)
 
     def active(ta, tb):
         return (
@@ -119,19 +127,40 @@ def build_flat_poisson(grid, f_pos, f_neg, scaling_leaf, types_leaf,
     orig = ex & ey & ez
     solve3 = t3 == solve_code
 
+    # leaf-origin mask: the one voxel per leaf whose coordinates are
+    # aligned to ITS leaf's block size — the generalized "each leaf
+    # counted once" selector for dots and writeback at any level spread
+    zi, yi, xi = np.meshgrid(np.arange(nz1), np.arange(ny1),
+                             np.arange(nx1), indexing="ij")
+    B3 = 1 << (vl - lev3)
+    leaf_origin = ((zi % B3 == 0) & (yi % B3 == 0) & (xi % B3 == 0))
+
+    # multi-level accumulation tables (reshape pyramid): per-doubling
+    # capture masks at their own reduced resolution; 2-level grids keep
+    # the tuned roll-chain (these stay unused there)
+    cap_masks, cap_active = [], []
+    for k in range(vl):
+        f = 1 << (k + 1)
+        lev_red = lev3[::f, ::f, ::f]
+        m = (lev_red == vl - 1 - k)
+        cap_masks.append(m.astype(np.float64))
+        cap_active.append(bool(m.any()))
+
     return dict(
         shape=shape,
         n_devices=lay["n_devices"],
+        vl=vl,
         rows=lay["rows"],
         fine=fine3,
         has_coarse=bool((~fine3).any()),
         weights=weights,
         scaling=scaling_vox.reshape(shape),
         solve=solve3,
-        # dot weights: each leaf counted once (fine voxel, or the coarse
-        # block's even-parity origin)
-        dot_mask=solve3 & (fine3 | orig),
+        # dot weights: each leaf counted once at its own origin voxel
+        dot_mask=solve3 & leaf_origin,
         orig=orig,
+        cap_masks=cap_masks,
+        cap_active=cap_active,
         wb_rows=lay["wb_rows"],
         wb_valid=lay["wb_valid"],
     )
@@ -189,29 +218,73 @@ def make_flat_poisson_apply(tables, dtype, mesh=None):
         s = s + jnp.roll(s, 1, 0)
         return fine * C + s
 
+    vl = int(tables.get("vl", 1))
+    cap_active = tables.get("cap_active") or []
+    kmax = max((k for k in range(len(cap_active)) if cap_active[k]),
+               default=-1)
+    caps_dev = [put(m, dtype) for m in (tables.get("cap_masks") or [])]
+
+    def _accum_ml(C, coarse, _orig, fine, *caps):
+        """Multi-level leaf-row totals: the flat_amr reshape pyramid
+        (plain sums, no volume factors — the Poisson S operator is a
+        block SUM).  Blocks never straddle slabs (slab % 2^vl == 0), so
+        the pyramid is slab-local."""
+        def down2(a):
+            nz_, ny_, nx_ = a.shape
+            return a.reshape(
+                nz_ // 2, 2, ny_ // 2, 2, nx_ // 2, 2
+            ).sum(axis=(1, 3, 5))
+
+        def up2(a):
+            nz_, ny_, nx_ = a.shape
+            return jnp.broadcast_to(
+                a[:, None, :, None, :, None], (nz_, 2, ny_, 2, nx_, 2)
+            ).reshape(nz_ * 2, ny_ * 2, nx_ * 2)
+
+        cur = C * coarse
+        subs = []
+        for _k in range(kmax + 1):
+            cur = down2(cur)
+            subs.append(cur)
+        acc = None
+        for k in range(kmax, -1, -1):
+            if acc is not None:
+                acc = up2(acc)
+            if cap_active[k]:
+                contrib = subs[k] * caps[k]
+                acc = contrib if acc is None else acc + contrib
+        out = fine * C
+        if acc is not None:
+            out = out + up2(acc)
+        return out
+
+    _accum_fn = _accum_ml if vl >= 2 else _accum_math
+    _accum_extra = tuple(caps_dev) if vl >= 2 else ()
     if D > 1 and has_coarse:
-        # run the whole chain per slab inside shard_map: the z-rolls stay
-        # slab-local (coarse blocks never straddle slabs), so no
-        # collective permutes enter the solver's hot loop for pooling
+        # run the whole chain per slab inside shard_map: the
+        # pooling/broadcast stays slab-local (coarse blocks never
+        # straddle slabs), so no collective permutes enter the solver's
+        # hot loop for it
         from jax import shard_map
         from ..parallel.mesh import SHARD_AXIS as _AX
         from jax.sharding import PartitionSpec as _P
 
         _vox_spec = _P(_AX, None, None)
         _accum_sharded = shard_map(
-            _accum_math, mesh=mesh,
-            in_specs=(_vox_spec,) * 4,
+            _accum_fn, mesh=mesh,
+            in_specs=(_vox_spec,) * (4 + len(_accum_extra)),
             out_specs=_vox_spec,
             check_vma=False,
         )
 
         def _accumulate(C):
-            return _accum_sharded(C, coarse_f, orig_f, fine_f)
+            return _accum_sharded(C, coarse_f, orig_f, fine_f,
+                                  *_accum_extra)
     else:
         def _accumulate(C):
             if not has_coarse:
                 return C
-            return _accum_math(C, coarse_f, orig_f, fine_f)
+            return _accum_fn(C, coarse_f, orig_f, fine_f, *_accum_extra)
 
     def apply_fwd(v):
         C = jnp.zeros(shape, dtype)

@@ -52,52 +52,114 @@ class HaloExchange:
     the leading axis.
     """
 
-    def __init__(self, epoch, hood, mesh):
+    def __init__(self, epoch, hood, mesh, cell_datatype=None, hood_id=None):
         self.mesh = mesh
         self.D = epoch.n_devices
         self.R = epoch.R
+        self.hood_id = hood_id
         #: cells moved per exchange (useful payload, for bandwidth
         #: accounting)
         self.cells_moved = int(hood.pair_counts.sum())
-        # --- ring schedule: step k ships d -> (d+k) % D.  Only distances
-        # some pair really uses appear, and each step is sized by ITS max
-        # pair count, not the global one.
         D = self.D
-        pc = hood.pair_counts
-        dd = np.arange(D)
-        self.ring_ks: list[int] = []
-        self.ring_perms: list[list] = []
-        send_tabs, recv_tabs = [], []
+        # exact per-pair row lists, the substrate every ring schedule is
+        # built from (the reference's send/recv lists,
+        # ``dccrg.hpp:8590-8889``)
+        pair_lists: dict = {}
+        for i in range(D):
+            for j in range(D):
+                c = int(hood.pair_counts[i, j])
+                if c:
+                    pair_lists[(i, j)] = (
+                        hood.send_rows[i, j, :c],
+                        hood.recv_rows[j, i, :c],
+                    )
+        self._pair_lists = pair_lists
+        #: per-cell dynamic payload policy (the reference's
+        #: ``get_mpi_datatype(cell_id, sender, receiver, receiving,
+        #: neighborhood_id)`` seam, ``dccrg_get_cell_datatype.hpp:48-125``):
+        #: ``cell_datatype(field, cell_ids, sender, receiver, hood_id)``
+        #: returns a bool mask — which of the pair's cells transfer this
+        #: field on this exchange.  Evaluated ONCE per epoch at schedule
+        #: build (the TPU trace-once analogue of the reference's per-call
+        #: virtual dispatch); both sides of each pair derive from the one
+        #: policy so send/recv schedules can never disagree the way a
+        #: buggy asymmetric ``receiving=true/false`` pair could.
+        self._cell_datatype = cell_datatype
+        self._sender_cell_ids = (
+            {key: epoch.cell_ids[key[0]][np.asarray(sr)]
+             for key, (sr, _rr) in pair_lists.items()}
+            if cell_datatype is not None else None
+        )
+        self._field_rings: dict = {}
+        self._selective_fns: dict = {}
+        (self.ring_ks, self.ring_perms, self.ring_send, self.ring_recv,
+         self.wire_cells, _cells) = self._ring_from_pairs(pair_lists)
+        self._fn = self._build()
+
+    def _ring_from_pairs(self, pair_lists):
+        """Ring schedule from exact per-pair row lists: step k ships
+        d -> (d+k) % D; only distances some pair actually uses appear,
+        each sized by ITS max pair count.  Tables go through the
+        ``put_table`` seam: sharded device arrays under one controller
+        (no per-call transfer on the hot path), host numpy constants
+        under many (jit closes over them transitively; closing over
+        another process's device array is rejected)."""
+        D, scratch = self.D, self.R - 1
+        ks, perms, send_dev, recv_dev = [], [], [], []
+        wire = 0
+        cells = 0
         for k in range(1, D):
-            dst = (dd + k) % D
-            S_k = int(pc[dd, dst].max()) if pc.size else 0
+            S_k = max(
+                (len(pair_lists[(d, (d + k) % D)][0])
+                 for d in range(D) if (d, (d + k) % D) in pair_lists),
+                default=0,
+            )
             if S_k == 0:
                 continue
-            # send_rows/recv_rows are padded to the global max with the
-            # scratch row; the first S_k slots cover every pair at this
-            # distance
-            st = hood.send_rows[dd, dst, :S_k]          # [D, S_k]
-            rt = hood.recv_rows[dd, (dd - k) % D, :S_k]  # [D, S_k]
-            self.ring_ks.append(k)
-            self.ring_perms.append([(d, (d + k) % D) for d in range(D)])
-            send_tabs.append(st)
-            recv_tabs.append(rt)
-        # single-controller: sharded device arrays (no per-call transfer
-        # on the TPU hot path).  multi-controller: host numpy — workload
-        # steps jit-wrap the exchange, so the tables are captured
-        # TRANSITIVELY by those outer traces, and closing over another
-        # process's device array is rejected; numpy constants embed
-        # freely.  The cost is a per-dispatch transfer of the (small)
-        # tables only under many controllers.
-        self.ring_send = [put_table(t, mesh) for t in send_tabs]
-        self.ring_recv = [put_table(t, mesh) for t in recv_tabs]
-        #: rows actually crossing the wire per exchange per leaf (each
-        #: ring step moves D * S_k rows, padding included) — the honest
-        #: wire-traffic figure the ring schedule is sized by
-        self.wire_cells = sum(
-            D * t.shape[-1] for t in send_tabs
-        )
-        self._fn = self._build()
+            st = np.full((D, S_k), scratch, np.int32)
+            rt = np.full((D, S_k), scratch, np.int32)
+            for d in range(D):
+                sr = pair_lists.get((d, (d + k) % D))
+                if sr is not None:
+                    st[d, :len(sr[0])] = sr[0]
+                    cells += len(sr[0])
+                rr = pair_lists.get(((d - k) % D, d))
+                if rr is not None:
+                    rt[d, :len(rr[1])] = rr[1]
+            ks.append(k)
+            perms.append([(d, (d + k) % D) for d in range(D)])
+            send_dev.append(put_table(st, self.mesh))
+            recv_dev.append(put_table(rt, self.mesh))
+            wire += D * S_k
+        return ks, perms, send_dev, recv_dev, wire, cells
+
+    def _rings_for_field(self, name: str):
+        """The (ks, perms, send, recv) schedule moving ``name``: the
+        shared full schedule without a policy, else the policy-filtered
+        one (cached per field per epoch)."""
+        if self._cell_datatype is None:
+            return (self.ring_ks, self.ring_perms, self.ring_send,
+                    self.ring_recv)
+        if name not in self._field_rings:
+            filtered = {}
+            for (i, j), (sr, rr) in self._pair_lists.items():
+                mask = np.asarray(self._cell_datatype(
+                    name, self._sender_cell_ids[(i, j)], i, j, self.hood_id
+                ), dtype=bool)
+                if mask.shape != (len(sr),):
+                    raise ValueError(
+                        f"cell_datatype mask for field {name!r} pair "
+                        f"({i}->{j}) has shape {mask.shape}, want "
+                        f"({len(sr)},)"
+                    )
+                if mask.any():
+                    filtered[(i, j)] = (np.asarray(sr)[mask],
+                                        np.asarray(rr)[mask])
+            ks, perms, send, recv, wire, cells = (
+                self._ring_from_pairs(filtered)
+            )
+            self._field_rings[name] = (ks, perms, send, recv, wire, cells)
+        return self._field_rings[name][:4]
 
     # --------------------------------------------------- wire protocol
 
@@ -158,13 +220,97 @@ class HaloExchange:
         # devices is rejected under multi-process SPMD
         return jax.jit(fn)
 
+    def _selective(self, names: tuple):
+        """Compiled per-field exchange for a cell_datatype policy: each
+        field rides its own (possibly empty) ring schedule inside ONE
+        shard_map, so a policy that strips a field from some cells costs
+        exactly the surviving rows on the wire."""
+        if names in self._selective_fns:
+            return self._selective_fns[names]
+        rings = [self._rings_for_field(n) for n in names]
+        nks = [len(r[0]) for r in rings]
+        perms_all = [r[1] for r in rings]
+        tab_args = []
+        for r in rings:
+            tab_args.extend(r[2])
+            tab_args.extend(r[3])
+        n_tabs = len(tab_args)
+        data_spec = P(SHARD_AXIS)
+        idx_spec = P(SHARD_AXIS, None)
+
+        def make_body(mode):
+            def body(*args):
+                pos = 0
+                tabs = []
+                for nk in nks:
+                    sends = [a[0] for a in args[pos:pos + nk]]
+                    recvs = [a[0] for a in args[pos + nk:pos + 2 * nk]]
+                    pos += 2 * nk
+                    tabs.append((sends, recvs))
+                fields = args[pos:pos + len(names)]
+                payloads_in = args[pos + len(names):]
+                out = []
+                for fi, ((sends, recvs), perms, x) in enumerate(
+                    zip(tabs, perms_all, fields)
+                ):
+                    blk = x[0]
+                    if mode == "start":
+                        out.append(tuple(
+                            p[None] for p in
+                            HaloExchange.ring_start(blk, perms, sends)
+                        ))
+                        continue
+                    if mode == "finish":
+                        pay = [q[0] for q in payloads_in[fi]]
+                    else:
+                        pay = HaloExchange.ring_start(blk, perms, sends)
+                    out.append(
+                        HaloExchange.ring_finish(blk, recvs, pay)[None]
+                    )
+                return tuple(out)
+
+            return body
+
+        def specs(extra):
+            return (idx_spec,) * n_tabs + (data_spec,) * len(names) + extra
+
+        block = jax.jit(shard_map(
+            make_body("block"), mesh=self.mesh,
+            in_specs=specs(()), out_specs=data_spec, check_vma=False,
+        ))
+        start = jax.jit(shard_map(
+            make_body("start"), mesh=self.mesh,
+            in_specs=specs(()), out_specs=data_spec, check_vma=False,
+        ))
+        finish = jax.jit(shard_map(
+            make_body("finish"), mesh=self.mesh,
+            in_specs=specs((data_spec,) * len(names)),
+            out_specs=data_spec, check_vma=False,
+        ))
+        self._selective_fns[names] = (block, start, finish, tab_args)
+        return self._selective_fns[names]
+
+    @staticmethod
+    def _names(state) -> tuple:
+        if not isinstance(state, dict):
+            raise TypeError(
+                "a cell_datatype exchange needs a {field: array} state "
+                "dict (fields are selected by name)"
+            )
+        return tuple(sorted(state))
+
     def __call__(self, state):
         if isinstance(state, HaloHandle):
             raise TypeError(
                 "got a HaloHandle where a state pytree belongs — pass the "
                 "handle as wait_remote_neighbor_copy_updates(state, handle)"
             )
-        return self._fn(*self.ring_send, *self.ring_recv, state)
+        if self._cell_datatype is None:
+            return self._fn(*self.ring_send, *self.ring_recv, state)
+        names = self._names(state)
+        block, _start, _finish, tab_args = self._selective(names)
+        outs = block(*tab_args, *(state[n] for n in names))
+        return {**state, **dict(zip(names, outs))}
 
     # ------------------------------------------------------- split-phase
 
@@ -234,6 +380,11 @@ class HaloExchange:
         pytree."""
         if isinstance(state, HaloHandle):
             raise TypeError("start() takes the state, not a HaloHandle")
+        if self._cell_datatype is not None:
+            names = self._names(state)
+            _block, start, _finish, tab_args = self._selective(names)
+            payload = start(*tab_args, *(state[n] for n in names))
+            return HaloHandle((names, payload))
         if not hasattr(self, "_start_fn"):
             self._build_split()
         return HaloHandle(self._start_fn(*self.ring_send, state))
@@ -244,20 +395,42 @@ class HaloExchange:
             raise TypeError(
                 "finish() expects the HaloHandle returned by start()"
             )
+        if self._cell_datatype is not None:
+            names, payload = handle.payload
+            if names != self._names(state):
+                raise ValueError("finish() got a different field set "
+                                 "than start()")
+            _block, _start, finish, tab_args = self._selective(names)
+            outs = finish(*tab_args, *(state[n] for n in names), *payload)
+            return {**state, **dict(zip(names, outs))}
         if not hasattr(self, "_finish_fn"):
             self._build_split()
         return self._finish_fn(*self.ring_recv, state, handle.payload)
 
     # ------------------------------------------------------- accounting
 
-    def _per_cell_bytes(self, state) -> int:
+    @staticmethod
+    def _per_cell_bytes(state) -> int:
         return sum(
             int(np.prod(x.shape[2:])) * x.dtype.itemsize
             for x in jax.tree_util.tree_leaves(state)
         )
 
+    def _per_field_totals(self, state) -> tuple[int, int]:
+        """(useful bytes, wire bytes) under the cell_datatype policy."""
+        useful = wire = 0
+        for n in self._names(state):
+            self._rings_for_field(n)
+            _ks, _perms, _s, _r, f_wire, f_cells = self._field_rings[n]
+            per = self._per_cell_bytes({n: state[n]})
+            useful += f_cells * per
+            wire += f_wire * per
+        return useful, wire
+
     def bytes_moved(self, state) -> int:
         """Useful payload bytes (real send-list rows) per exchange."""
+        if self._cell_datatype is not None:
+            return self._per_field_totals(state)[0]
         return self.cells_moved * self._per_cell_bytes(state)
 
     def wire_bytes(self, state) -> int:
@@ -265,4 +438,6 @@ class HaloExchange:
         moves ``D * S_k`` rows (its own max pair count, padding
         included), so this scales with the real communication pattern —
         not with worst-pair x D^2 as a padded all_to_all would."""
+        if self._cell_datatype is not None:
+            return self._per_field_totals(state)[1]
         return self.wire_cells * self._per_cell_bytes(state)

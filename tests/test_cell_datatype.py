@@ -1,0 +1,160 @@
+"""Per-cell dynamic payload selection at the exchange seam — the
+reference's ``get_mpi_datatype(cell_id, sender, receiver, receiving,
+neighborhood_id)`` (``dccrg_get_cell_datatype.hpp:48-125``), where a
+cell can vary its transferred content per exchange and neighborhood.
+Here the policy is a host callback evaluated at schedule-compile time;
+ghost copies of unselected cells keep their previous values, exactly
+like data a reference cell leaves out of its returned datatype."""
+import numpy as np
+import pytest
+
+from dccrg_tpu import Grid, make_mesh
+
+
+def make_grid(hood=1, length=(8, 8, 1)):
+    return (
+        Grid()
+        .set_initial_length(length)
+        .set_neighborhood_length(hood)
+        .initialize(mesh=make_mesh(n_devices=8))
+    )
+
+
+def even_cells_only(field, cell_ids, sender, receiver, hood_id):
+    """rho travels only for even cell ids; aux always travels."""
+    if field == "rho":
+        return np.asarray(cell_ids, np.uint64) % 2 == 0
+    return np.ones(len(cell_ids), bool)
+
+
+def _ghost_map(g):
+    """{(device, row): cell_id} for every ghost row."""
+    out = {}
+    ep = g.epoch
+    for d in range(g.n_devices):
+        for k, pos in enumerate(ep.ghost_pos[d]):
+            out[(d, int(ep.n_local[d] + k))] = int(ep.leaves.cells[pos])
+    return out
+
+
+def _states(g):
+    spec = {"rho": ((), np.float64), "aux": ((), np.float64)}
+    st = g.new_state(spec, fill=-1.0)
+    cells = g.get_cells()
+    st = g.set_cell_data(st, "rho", cells, cells.astype(np.float64))
+    st = g.set_cell_data(st, "aux", cells, 100.0 + cells.astype(np.float64))
+    return st
+
+
+def test_policy_gates_per_cell_per_field():
+    g = make_grid()
+    st = _states(g)
+    full = g.halo(None)(st)
+    sel = g.halo(None, cell_datatype=even_cells_only)(st)
+    rho_f, rho_s = np.asarray(full["rho"]), np.asarray(sel["rho"])
+    aux_f, aux_s = np.asarray(full["aux"]), np.asarray(sel["aux"])
+    checked_even = checked_odd = 0
+    for (d, row), cid in _ghost_map(g).items():
+        # aux always transfers: identical to the full exchange
+        assert aux_s[d, row] == aux_f[d, row] == 100.0 + cid
+        if cid % 2 == 0:
+            assert rho_s[d, row] == rho_f[d, row] == cid
+            checked_even += 1
+        else:
+            # unselected: the ghost keeps its pre-exchange fill value
+            assert rho_s[d, row] == -1.0
+            checked_odd += 1
+    assert checked_even and checked_odd
+
+
+def test_policy_reduces_wire_bytes():
+    g = make_grid()
+    st = _states(g)
+    full = g.halo(None)
+    sel = g.halo(None, cell_datatype=even_cells_only)
+    assert sel.bytes_moved(st) < full.bytes_moved(st)
+    assert sel.wire_bytes(st) <= full.wire_bytes(st)
+    # aux moves everywhere, rho only from even cells
+    only_aux = {"aux": st["aux"]}
+    assert sel.bytes_moved(only_aux) == full.bytes_moved(only_aux)
+
+
+def test_split_phase_matches_blocking_under_policy():
+    g = make_grid()
+    st = _states(g)
+    h = g.halo(None, cell_datatype=even_cells_only)
+    blocking = h(st)
+    handle = h.start(st)
+    merged = h.finish(st, handle)
+    for f in ("rho", "aux"):
+        np.testing.assert_array_equal(
+            np.asarray(blocking[f]), np.asarray(merged[f])
+        )
+
+
+def test_grid_level_policy_and_epoch_rebuild():
+    """set_cell_datatype installs the policy for the default halo()
+    route; an epoch rebuild (balance_load) recompiles the schedule
+    against the new send lists with the same policy."""
+    g = make_grid()
+    g.set_cell_datatype(even_cells_only)
+    st = _states(g)
+    out = g.update_copies_of_remote_neighbors(st)
+    gm = _ghost_map(g)
+    odd = [(d, r) for (d, r), cid in gm.items() if cid % 2 == 1]
+    assert odd
+    assert all(np.asarray(out["rho"])[d, r] == -1.0 for d, r in odd)
+
+    g.balance_load()
+    st2 = _states(g)
+    out2 = g.update_copies_of_remote_neighbors(st2)
+    gm2 = _ghost_map(g)
+    for (d, r), cid in gm2.items():
+        want = -1.0 if cid % 2 == 1 else float(cid)
+        assert np.asarray(out2["rho"])[d, r] == want
+
+    g.set_cell_datatype(None)
+    out3 = g.update_copies_of_remote_neighbors(_states(g))
+    assert all(
+        np.asarray(out3["rho"])[d, r] == cid
+        for (d, r), cid in _ghost_map(g).items()
+    )
+
+
+def test_policy_sees_neighborhood_and_pair():
+    """The policy receives (sender, receiver, hood_id) — a policy keyed
+    on the neighborhood produces different schedules per hood, the
+    reference's neighborhood_id-dependent datatype."""
+    g = make_grid()
+    assert g.add_neighborhood(7, [(0, 1, 0)])
+    seen = set()
+
+    def spy(field, cell_ids, sender, receiver, hood_id):
+        seen.add((sender, receiver, hood_id))
+        return (np.ones(len(cell_ids), bool) if hood_id == 7
+                else np.zeros(len(cell_ids), bool))
+
+    st = _states(g)
+    out_default = g.halo(None, cell_datatype=spy)(st)
+    out_hood7 = g.halo(7, cell_datatype=spy)(st)
+    assert any(h == 7 for (_s, _r, h) in seen)
+    assert any(h is None for (_s, _r, h) in seen)
+    assert all(s != r for (s, r, _h) in seen)
+    # default hood: everything masked out -> ghosts untouched
+    gm = _ghost_map(g)
+    assert all(
+        np.asarray(out_default["rho"])[d, r] == -1.0 for d, r in gm
+    )
+    # hood 7: its (sparser) ghost set fully refreshed
+    assert np.asarray(out_hood7["rho"]).max() > 0
+
+
+def test_bad_mask_shape_raises():
+    g = make_grid()
+    st = _states(g)
+
+    def bad(field, cell_ids, sender, receiver, hood_id):
+        return np.ones(3, bool)
+
+    with pytest.raises(ValueError, match="mask"):
+        g.halo(None, cell_datatype=bad)(st)

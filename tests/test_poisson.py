@@ -313,6 +313,62 @@ def test_flat_path_matches_gather_refined():
     np.testing.assert_allclose(sf, sg, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.parametrize("n_dev", [1, 8])
+def test_flat_path_three_levels_matches_gather(n_dev):
+    """VERDICT-r4 item 3: the flat operator now covers 3+ leaf levels
+    (per-voxel sub-face weights 1/4^(vl-level), reshape-pyramid block
+    sums).  The matvec must equal the gather path to f64 roundoff —
+    the sharp operator-identity test — and the solve to BiCG rounding
+    accumulation; the whole-solve Pallas kernel stays gated to 2
+    levels."""
+    g = make_grid((8, 8, 8), max_ref=2, n_dev=n_dev)
+    for rad in (0.3, 0.2):
+        ids = g.get_cells()
+        c = g.geometry.get_center(ids)
+        r = np.linalg.norm(c - 0.5, axis=1)
+        lv = g.mapping.get_refinement_level(ids)
+        for cid in ids[(r < rad) & (lv == lv.max())]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    assert g.mapping.get_refinement_level(g.get_cells()).max() == 2
+    ids = np.sort(g.leaves.cells)
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+    rhs -= rhs.mean()
+
+    p_flat = Poisson(g)
+    assert p_flat._flat is not None, "flat path must engage at 3 levels"
+    assert p_flat._flat_tables["vl"] == 2
+    assert p_flat._solve_fast is None
+    p_gather = Poisson(g, allow_flat=False, use_pallas=False)
+
+    # operator identity on a random vector, forward and transpose
+    rng = np.random.default_rng(1)
+    v = rng.standard_normal(len(ids))
+    sv = g.set_cell_data(g.new_state({"x": ((), np.float64)}), "x", ids, v)
+    fwd, rev, vox, wb, _masks = p_flat._flat
+    mf, mr = p_gather._mult_tables()
+    for mult, fl in ((mf, fwd), (mr, rev)):
+        a_g, _ = p_gather._apply(sv["x"], mult)
+        a_f = wb(fl(vox(sv["x"])))
+        ag = np.asarray(g.get_cell_data({"x": a_g}, "x", ids))
+        af = np.asarray(g.get_cell_data({"x": a_f}, "x", ids))
+        np.testing.assert_allclose(af, ag, rtol=1e-13, atol=1e-13)
+
+    # solve-level agreement (dot association differs -> BiCG rounding)
+    s0 = p_flat.initialize_state(rhs)
+    out_f, _rf, it_f = p_flat.solve(s0, max_iterations=40,
+                                    stop_residual=0.0,
+                                    stop_after_residual_increase=np.inf)
+    out_g, _rg, it_g = p_gather.solve(s0, max_iterations=40,
+                                      stop_residual=0.0,
+                                      stop_after_residual_increase=np.inf)
+    assert it_f == it_g
+    sf = np.asarray(g.get_cell_data(out_f, "solution", ids))
+    sg = np.asarray(g.get_cell_data(out_g, "solution", ids))
+    np.testing.assert_allclose(sf, sg, rtol=1e-6, atol=1e-8)
+
+
 def test_flat_path_matches_gather_uniform_with_roles():
     """Flat path on a uniform grid with skip and boundary cells: the cell
     role rules (poisson_solve.hpp:896-965) survive the flat folding."""

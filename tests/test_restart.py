@@ -84,13 +84,29 @@ def test_vtk_writer(tmp_path):
     )
     g.refine_completely(1)
     g.stop_refining()
+    n = len(g.get_cells())
+    rho = np.arange(n)
+    # ASCII: eyeball-readable, all sections present
     path = tmp_path / "grid.vtk"
-    g.write_vtk_file(str(path), scalars={"rho": np.arange(len(g.get_cells()))})
+    g.write_vtk_file(str(path), scalars={"rho": rho}, binary=False)
     text = path.read_text()
     assert "UNSTRUCTURED_GRID" in text
-    n = len(g.get_cells())
     assert f"CELLS {n} {9*n}" in text
     assert "SCALARS rho" in text
+    # BINARY (default): same structure, payload decodes to the same data
+    pb = tmp_path / "grid_bin.vtk"
+    g.write_vtk_file(str(pb), scalars={"rho": rho})
+    raw = pb.read_bytes()
+    assert b"BINARY" in raw and f"CELLS {n} {9*n}".encode() in raw
+    pts_off = raw.index(b"float\n") + len(b"float\n")
+    pts = np.frombuffer(raw[pts_off:pts_off + 8 * n * 3 * 4], ">f4")
+    mins = g.geometry.get_min(g.get_cells())
+    np.testing.assert_allclose(pts.reshape(n, 8, 3)[:, 0], mins, rtol=1e-6)
+    sc_off = raw.index(b"LOOKUP_TABLE default\n") + len(
+        b"LOOKUP_TABLE default\n"
+    )
+    got = np.frombuffer(raw[sc_off:sc_off + 4 * n], ">f4")
+    np.testing.assert_allclose(got, rho.astype(np.float32))
 
 
 def test_variable_size_payload_roundtrip(tmp_path):

@@ -127,6 +127,8 @@ out = bench.measure_poisson(allow_flat=False, use_pallas=False,
 out["device_kind"] = jax.devices()[0].device_kind
 print(json.dumps(out))
 """, 1500),
+    "poisson3": ("import bench\nprint(json.dumps(bench.measure_poisson3()))",
+                 1500),
     "vlasov": ("import bench\nprint(json.dumps(bench.measure_vlasov()))",
                1500),
     "flat_kernel_sweep_Bvox_per_s": ("""

@@ -545,13 +545,13 @@ def one(seed):
     n = int(rng.choice([4, 6, 8]))
     n_dev = int(rng.choice([1, 2, 4]))
     periodic = tuple(bool(b) for b in rng.integers(0, 2, 3))
-    maxref = int(rng.integers(0, 2))
+    maxref = int(rng.integers(0, 3))   # 0-2: up to 3 leaf levels
     g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
          .set_periodic(*periodic).set_maximum_refinement_level(maxref)
          .set_geometry(CartesianGeometry, start=(0.,0.,0.),
                        level_0_cell_length=(1./n,)*3)
          .initialize(mesh=make_mesh(n_devices=n_dev)))
-    if maxref:
+    for _round in range(maxref):
         ids = g.get_cells()
         k = max(1, int(0.2 * len(ids)))
         for cid in rng.choice(ids, size=k, replace=False):
