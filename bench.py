@@ -204,28 +204,20 @@ def measure_refined(force: str | None = None) -> dict:
     }
 
 
-def measure_refined3(force: str | None = None) -> dict:
-    """Three-level AMR grid (VERDICT-r4 item 5's 'done' config): ball
-    refined twice, comparing the multi-level flat XLA whole-run
-    (``ops/flat_amr.build_flat_ml_tables``) against the boxed per-level
-    passes on the reference's deep-AMR regime
-    (``dccrg_mapping.hpp:316-329`` allows 21 levels).
-
-    ``force``: None lets the cost edge choose; "ml"/"boxed" pin the
-    path so each side is measured directly."""
-    import jax
+def _ball_refined_grid(n: int, radii: tuple, max_ref: int):
+    """Periodic n^3 grid with a centered ball refined once per radius —
+    the shared multi-level benchmark construction (one definition keeps
+    refined3 and poisson3 measuring the same grid family)."""
     import numpy as np
 
     from dccrg_tpu import CartesianGeometry, Grid, make_mesh
-    from dccrg_tpu.models import Advection
 
-    n = REFINED3_N
     g = (
         Grid()
         .set_initial_length((n, n, n))
         .set_neighborhood_length(0)
         .set_periodic(True, True, True)
-        .set_maximum_refinement_level(2)
+        .set_maximum_refinement_level(max_ref)
         .set_geometry(
             CartesianGeometry,
             start=(0.0, 0.0, 0.0),
@@ -233,7 +225,7 @@ def measure_refined3(force: str | None = None) -> dict:
         )
         .initialize(mesh=make_mesh())
     )
-    for rad in REFINED3_RADII:
+    for rad in radii:
         ids = g.get_cells()
         c = g.geometry.get_center(ids)
         r = np.linalg.norm(c - 0.5, axis=1)
@@ -241,6 +233,24 @@ def measure_refined3(force: str | None = None) -> dict:
         for cid in ids[(r < rad) & (lv == lv.max())]:
             g.refine_completely(int(cid))
         g.stop_refining()
+    return g
+
+
+def measure_refined3(force: str | None = None) -> dict:
+    """Three-level AMR grid (VERDICT-r4 item 5's 'done' config): ball
+    refined twice, comparing the multi-level flat whole-run forms
+    (``ops/flat_amr``) against the boxed per-level passes on the
+    reference's deep-AMR regime (``dccrg_mapping.hpp:316-329`` allows
+    21 levels).
+
+    ``force``: None lets the cost edge choose; "ml"/"boxed" pin the
+    path so each side is measured directly."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu.models import Advection
+
+    g = _ball_refined_grid(REFINED3_N, REFINED3_RADII, 2)
     ids = g.get_cells()
     n_cells = len(ids)
     levels = sorted(
@@ -252,9 +262,9 @@ def measure_refined3(force: str | None = None) -> dict:
     dt = np.float32(0.4 * adv.max_time_step(state))
     steps = REFINED3_STEPS
     if force == "ml":
-        assert adv._flat_kind == "ml", adv._flat_kind
+        assert adv._flat_kind in ("ml", "ml_pallas"), adv._flat_kind
         runner = lambda: adv._flat_run(state, steps, dt)  # noqa: E731
-        path = "ml"
+        path = adv._flat_kind
     elif force == "boxed":
         assert adv.boxed is not None
         adv._prefer_boxed = True
@@ -570,31 +580,9 @@ def measure_poisson3() -> dict:
     import jax
     import numpy as np
 
-    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
     from dccrg_tpu.models import Poisson
 
-    n = 16
-    g = (
-        Grid()
-        .set_initial_length((n, n, n))
-        .set_neighborhood_length(0)
-        .set_periodic(True, True, True)
-        .set_maximum_refinement_level(2)
-        .set_geometry(
-            CartesianGeometry,
-            start=(0.0, 0.0, 0.0),
-            level_0_cell_length=(1.0 / n,) * 3,
-        )
-        .initialize(mesh=make_mesh())
-    )
-    for rad in (0.35, 0.25):
-        ids = g.get_cells()
-        c = g.geometry.get_center(ids)
-        r = np.linalg.norm(c - 0.5, axis=1)
-        lv = g.mapping.get_refinement_level(ids)
-        for cid in ids[(r < rad) & (lv == lv.max())]:
-            g.refine_completely(int(cid))
-        g.stop_refining()
+    g = _ball_refined_grid(16, (0.35, 0.25), 2)
     ids = g.get_cells()
     c = g.geometry.get_center(ids)
     rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])

@@ -517,15 +517,24 @@ class Grid:
         epoch).  ``cell_datatype`` overrides the grid-level policy for
         this schedule (``...`` = inherit, None = full payloads)."""
         self._assert_initialized()
-        policy = (getattr(self, "_cell_datatype", None)
-                  if cell_datatype is ... else cell_datatype)
-        key = (hood_id, policy)
-        if key not in self._halo_cache:
-            self._halo_cache[key] = HaloExchange(
-                self.epoch, self.epoch.hoods[hood_id], self.mesh,
-                cell_datatype=policy, hood_id=hood_id,
-            )
-        return self._halo_cache[key]
+        installed = getattr(self, "_cell_datatype", None)
+        policy = installed if cell_datatype is ... else cell_datatype
+        # only the installed policy and the no-policy schedule are
+        # cached: an ad-hoc override (often a fresh closure per call)
+        # must not grow the cache without bound — it gets a fresh,
+        # caller-owned schedule instead
+        if policy is None or policy is installed:
+            key = (hood_id, policy)
+            if key not in self._halo_cache:
+                self._halo_cache[key] = HaloExchange(
+                    self.epoch, self.epoch.hoods[hood_id], self.mesh,
+                    cell_datatype=policy, hood_id=hood_id,
+                )
+            return self._halo_cache[key]
+        return HaloExchange(
+            self.epoch, self.epoch.hoods[hood_id], self.mesh,
+            cell_datatype=policy, hood_id=hood_id,
+        )
 
     def update_copies_of_remote_neighbors(self, state, hood_id=None):
         """Blocking ghost refresh (reference ``dccrg.hpp:966-1000``)."""

@@ -59,6 +59,9 @@ __all__ = [
     "make_flat_amr_run_sharded",
     "build_flat_ml_tables",
     "make_flat_ml_run",
+    "make_flat_ml_run_pallas",
+    "compute_flat_ml_weights",
+    "flat_ml_kernel_fits",
     "pad_lane_extent",
 ]
 
@@ -744,12 +747,26 @@ def build_flat_ml_tables(grid):
     # whose leaf level equals l marks exactly that leaf's block (leaves
     # of level l are always aligned to their own block size)
     caps = []
+    cap_origin = []
+    if D == 1:
+        # full-resolution origin masks are only consumed by the
+        # single-device Pallas whole-run kernel; sharded grids must not
+        # pay vl extra full-resolution f64 arrays for nothing
+        zi, yi, xi = np.meshgrid(np.arange(nzl), np.arange(nyv),
+                                 np.arange(nxv), indexing="ij")
     for k in range(vl):
         l = vl - 1 - k
         f = 1 << (k + 1)
         lev_red = lev_loc[:, ::f, ::f, ::f]
         inv_vol = 1.0 / (vol_f * float(8 ** (k + 1)))
         caps.append((lev_red == l).astype(np.float64) * inv_vol)
+        if D == 1:
+            # roll-chain capture points for the Pallas whole-run kernel
+            aligned = (zi % f == 0) & (yi % f == 0) & (xi % f == 0)
+            cap_origin.append(
+                ((lev_loc == l) & aligned[None]).astype(np.float64)
+                * inv_vol
+            )
 
     return dict(
         shape=(nzl, nyv, nxv),
@@ -765,6 +782,7 @@ def build_flat_ml_tables(grid):
         updf=updf,
         pool=pool,
         caps=caps,
+        cap_origin=cap_origin,
         cap_active=[bool(c.any()) for c in caps],
         area_f=np.array([lf[1] * lf[2], lf[0] * lf[2], lf[0] * lf[1]]),
         periodic=tuple(bool(grid.topology.is_periodic(d)) for d in range(3)),
@@ -961,3 +979,144 @@ def make_flat_ml_run(grid, tables, dtype=jnp.float32):
         }
 
     return run_fn
+
+
+def compute_flat_ml_weights(tables, VX, VY, VZ, dtype=jnp.float32):
+    """Per-voxel-face upwind weights for the multi-level layout on a
+    single device (full-domain rolls = periodic wrap), mirroring the
+    sharded body's ringed-face math: level-weighted face velocities and
+    intra-leaf masking from the per-voxel leaf levels/ids."""
+    nzl, nyv, nxv = tables["shape"]
+    assert tables["n_devices"] == 1
+    lev = jnp.asarray(tables["lev"][0])
+    lidx = jnp.asarray(tables["lidx"][0])
+    area = tables["area_f"]
+    periodic = tables["periodic"]
+    out = []
+    for d, vel, n in ((0, VX, nxv), (1, VY, nyv), (2, VZ, nzl)):
+        ax = 2 - d
+        v = vel.astype(dtype)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (nzl, nyv, nxv), ax)
+        extra = None if periodic[d] else (pos == n - 1)
+        out.append(_face_weights_ml(
+            v, jnp.roll(v, -1, ax),
+            lev, jnp.roll(lev, -1, ax),
+            lidx, jnp.roll(lidx, -1, ax),
+            area[d], dtype, extra,
+        ))
+    return out
+
+
+def flat_ml_kernel_fits(n_voxels: int, vl: int) -> bool:
+    """VMEM budget for the multi-level whole-run kernel: the 2-level
+    kernel's ~18 resident arrays plus one capture mask per doubling."""
+    return (_FLAT_ARRAYS + vl) * n_voxels * 4 <= _FLAT_VMEM_BUDGET
+
+
+def make_flat_ml_run_pallas(nz1: int, ny1: int, nx1: int, vl: int,
+                            cap_active, *, interpret: bool = False):
+    """Whole-run fused Pallas kernel for MULTI-level flat AMR — the
+    VMEM-resident counterpart of :func:`make_flat_ml_run` for a single
+    device: the entire multi-step loop in one launch, with the coarse
+    updates as the hierarchical roll-chain (``pltpu.roll`` takes
+    arbitrary shifts, so pooling distance doubles per level).
+
+    Returns ``run(V, wpx, wnx, wpy, wny, wpz, wnz, updf, pool,
+    *caps, dt, steps) -> V'`` where ``updf`` folds 1/vol_fine into the
+    finest-voxel mask, ``pool`` masks non-finest voxels, and ``caps[k]``
+    marks level ``vl-1-k`` leaves' block ORIGINS with 1/vol folded (the
+    roll-chain capture points, full resolution)."""
+    if interpret:
+        roll_m = lambda x, h, a: jnp.roll(x, -h, a)
+        roll_p = lambda x, h, a: jnp.roll(x, h, a)
+    else:
+        roll_m = lambda x, h, a: pltpu.roll(x, x.shape[a] - h, a)
+        roll_p = lambda x, h, a: pltpu.roll(x, h, a)
+    kmax = max((k for k in range(vl) if cap_active[k]), default=-1)
+    n_caps = kmax + 1
+
+    def kernel(steps_ref, v_ref, wpx, wnx, wpy, wny, wpz, wnz,
+               updf_ref, pool_ref, *rest):
+        cap_refs = rest[:n_caps]
+        out_ref, scr_ref = rest[n_caps], rest[n_caps + 1]
+        steps = steps_ref[0]
+
+        def one_step(src_ref, dst_ref):
+            v = src_ref[...]
+            fx = v * wpx[...] + roll_m(v, 1, 2) * wnx[...]
+            delta = roll_p(fx, 1, 2) - fx
+            fy = v * wpy[...] + roll_m(v, 1, 1) * wny[...]
+            delta = delta + roll_p(fy, 1, 1) - fy
+            fz = v * wpz[...] + roll_m(v, 1, 0) * wnz[...]
+            delta = delta + roll_p(fz, 1, 0) - fz
+            res_add = delta * updf_ref[...]
+            # hierarchical pool: after step k, position p holds the sum
+            # of s over its 2^(k+1)-cube; capture masks read it only at
+            # level-aligned block origins, so wrap artifacts never land
+            # on a captured value, and each captured origin broadcasts
+            # its total (scaled by 1/vol, folded into the mask) over its
+            # own block via shifts summing to < block size
+            s = delta * pool_ref[...]
+            for k in range(kmax + 1):
+                h = 1 << k
+                s = s + roll_m(s, h, 2)
+                s = s + roll_m(s, h, 1)
+                s = s + roll_m(s, h, 0)
+                if not cap_active[k]:
+                    continue
+                c = s * cap_refs[k][...]
+                for j in range(k, -1, -1):
+                    hj = 1 << j
+                    c = c + roll_p(c, hj, 2)
+                    c = c + roll_p(c, hj, 1)
+                    c = c + roll_p(c, hj, 0)
+                res_add = res_add + c
+            dst_ref[...] = v + res_add
+
+        out_ref[...] = v_ref[...]
+
+        def body(i, _):
+            even = (i % 2) == 0
+
+            @pl.when(even)
+            def _():
+                one_step(out_ref, scr_ref)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                one_step(scr_ref, out_ref)
+
+            return 0
+
+        jax.lax.fori_loop(0, steps, body, 0)
+
+        @pl.when((steps % 2) == 1)
+        def _():
+            out_ref[...] = scr_ref[...]
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_FLAT_VMEM_BUDGET
+        )
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[smem] + [vmem] * (9 + n_caps),
+        out_specs=vmem,
+        scratch_shapes=[pltpu.VMEM((nz1, ny1, nx1), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((nz1, ny1, nx1), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+    def run(V, wpx, wnx, wpy, wny, wpz, wnz, updf, pool, caps, dt, steps):
+        dt = jnp.asarray(dt, jnp.float32)
+        steps_arr = jnp.asarray(steps, jnp.int32).reshape(1)
+        return call(
+            steps_arr, V, wpx * dt, wnx * dt, wpy * dt, wny * dt,
+            wpz * dt, wnz * dt, updf, pool, *caps[:n_caps],
+        )
+
+    return run

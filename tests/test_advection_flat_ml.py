@@ -94,6 +94,28 @@ def test_ml_flat_nonperiodic_boundaries():
     assert np.abs(a - b).max() / np.abs(b).max() < 1e-11
 
 
+def test_ml_pallas_kernel_matches_general_path():
+    """The VMEM-resident multi-level Pallas kernel (interpret mode on
+    CPU) must agree with the general gather path — the hierarchical
+    roll-chain capture/broadcast vs the reference semantics."""
+    g = ball_grid(1)
+    ids = np.sort(g.leaves.cells)
+    adv_k = Advection(g, dtype=np.float32, use_pallas="interpret")
+    assert adv_k._flat_kind == "ml_pallas_interpret", adv_k._flat_kind
+    adv_gen = Advection(g, dtype=np.float32, use_pallas=False,
+                        allow_boxed=False)
+    s_k = adv_k.initialize_state()
+    s = adv_gen.initialize_state()
+    dt = np.float32(0.3 * adv_gen.max_time_step(s))
+    steps = 6
+    out = adv_k._flat_run(s_k, steps, dt)
+    for _ in range(steps):
+        s = adv_gen.step(s, dt)
+    a = np.asarray(g.get_cell_data(out, "density", ids), np.float64)
+    b = np.asarray(g.get_cell_data(s, "density", ids), np.float64)
+    assert np.abs(a - b).max() / np.abs(b).max() < 5e-6
+
+
 def test_two_level_grids_keep_the_tuned_paths():
     """Levels {0, 1} must still dispatch to the existing 2-level flat
     forms (Pallas kernel / sharded XLA), not the ml generalization."""
