@@ -33,13 +33,13 @@ from ..utils.fallback import fallback_call
 __all__ = ["Advection"]
 
 
-def _flat_boxed_edge() -> float:
-    """The flat-vs-boxed dispatch edge: prefer the boxed per-level
-    passes when ``flat_n_vox > edge * boxed_vol``.  Measured on chip and
-    written by ``tools/recalibrate.py --write``; default is the
-    r2-measured ~2x flat per-voxel advantage.  A missing, malformed, or
-    out-of-range file falls back to the default — a calibration artifact
-    must never break or silently pin the dispatch."""
+def _calibrated_edge(key: str, default: float) -> float:
+    """A flat-vs-boxed dispatch edge constant: prefer the boxed
+    per-level passes when ``flat_n_vox > edge * boxed_vol``.  Measured
+    on chip and written by ``tools/recalibrate.py --write``.  A missing,
+    malformed, or out-of-range file falls back to the default — a
+    calibration artifact must never break or silently pin the
+    dispatch."""
     import json
     import math
     import pathlib
@@ -47,12 +47,32 @@ def _flat_boxed_edge() -> float:
     path = (pathlib.Path(__file__).resolve().parents[2]
             / "tools" / "dispatch_calibration.json")
     try:
-        edge = float(json.loads(path.read_text())["flat_boxed_edge"])
+        edge = float(json.loads(path.read_text())[key])
     except (OSError, KeyError, ValueError, TypeError):
-        return 2.0
+        return default
     if not math.isfinite(edge) or not 0.5 <= edge <= 100.0:
-        return 2.0
+        return default
     return edge
+
+
+def _flat_boxed_edge() -> float:
+    """2-level Pallas-kernel edge; default = the r2-measured ~2x flat
+    per-voxel advantage."""
+    return _calibrated_edge("flat_boxed_edge", 2.0)
+
+
+def _ml_boxed_edge(kind: str) -> float:
+    """Multi-level (3+ level) whole-run edge, per FORM: the
+    VMEM-resident Pallas kernel and the streaming XLA pyramid have
+    different per-voxel rates, so each calibrates from a battery run
+    that measured ITS kind (tools/recalibrate.py names the key after
+    refined3_ml's recorded path).  Defaults until measured: 2.0 for the
+    kernel (the 2-level kernel's measured class of advantage), 1.5 for
+    the XLA form (streams like the boxed passes, modest slack for their
+    per-level pass/concat overhead)."""
+    if kind == "ml_pallas":
+        return _calibrated_edge("ml_pallas_boxed_edge", 2.0)
+    return _calibrated_edge("ml_boxed_edge", 1.5)
 
 
 class Advection:
@@ -96,14 +116,17 @@ class Advection:
             # slab-mode boxed but handled exactly by the flat rolls)
             self._flat_run = self._build_flat_run()
             # cost-based choice when both fast paths qualify: prefer
-            # boxed only when the flat kernel's voxel inflation exceeds
-            # its measured per-voxel rate advantage over the boxed
-            # passes.  The edge constant comes from the on-chip battery
-            # via ``tools/recalibrate.py --write`` (falling back to the
-            # r2-measured ~2x when no calibration file exists).  Only
-            # the compiled single-device Pallas branch is calibrated —
-            # interpret mode (tests) and the sharded XLA form keep the
-            # flat preference so the flat numerics stay exercised
+            # boxed only when the flat form's voxel inflation exceeds
+            # its per-voxel rate advantage over the boxed passes.  Each
+            # compiled form reads its own edge constant from
+            # tools/dispatch_calibration.json (written by
+            # ``tools/recalibrate.py --write`` from the on-chip
+            # battery's pinned measurements: flat_boxed_edge for the
+            # 2-level kernel, ml_pallas_boxed_edge / ml_boxed_edge for
+            # the multi-level forms), with documented defaults until a
+            # battery run lands.  Interpret mode (tests) and the
+            # 2-level sharded XLA form keep the flat preference so the
+            # flat numerics stay exercised
             if (
                 self._flat_kind in ("pallas", "ml", "ml_pallas")
                 and self._flat_run is not None
@@ -112,14 +135,8 @@ class Advection:
                 boxed_vol = sum(
                     int(np.prod(b.shape)) for b in self.boxed.boxes.values()
                 )
-                # the VMEM-resident kernels carry the calibrated
-                # per-voxel advantage; the multi-level XLA form streams
-                # like the boxed passes (same op set, no VMEM residency
-                # edge), so its dispatch edge is the plain volume ratio
-                # with modest slack for the boxed path's per-level
-                # pass/concat overhead — uncalibrated until the on-chip
-                # battery measures it
-                edge = 1.5 if self._flat_kind == "ml" else _flat_boxed_edge()
+                edge = (_flat_boxed_edge() if self._flat_kind == "pallas"
+                        else _ml_boxed_edge(self._flat_kind))
                 self._prefer_boxed = self._flat_n_vox > edge * boxed_vol
 
     # ------------------------------------------------------ static tables

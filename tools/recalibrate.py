@@ -72,16 +72,19 @@ def main():
                       f"dispatch "
                       f"{'FLIPS to flat' if rec > ratio else 'stays boxed'} "
                       f"on that config with that constant")
+            ml_key, ml_rec = _ml_edge(data)
             ok_to_write = 0.5 <= rec <= 100.0
             if "--write" in sys.argv:
                 if not ok_to_write:
                     sys.exit(f"refusing to write out-of-range edge {rec}")
+                record = {
+                    "flat_boxed_edge": rec,
+                    "source": "tools/recalibrate.py from onchip battery",
+                }
+                if ml_rec is not None:
+                    record[ml_key] = ml_rec
                 out = ROOT / "tools" / "dispatch_calibration.json"
-                out.write_text(json.dumps(
-                    {"flat_boxed_edge": rec,
-                     "source": "tools/recalibrate.py from onchip battery"},
-                    indent=1,
-                ))
+                out.write_text(json.dumps(record, indent=1))
                 print(f"wrote {out} — models/advection.py reads it at "
                       "dispatch time")
     else:
@@ -90,6 +93,34 @@ def main():
               "production dispatch's path)")
         if "--write" in sys.argv:
             sys.exit("refusing to write without a refined_boxed record")
+
+
+def _ml_edge(data):
+    """(key, edge) for the multi-level dispatch from the PINNED
+    refined3_ml / refined3_boxed pair (both measure the identical
+    3-level config, so the per-voxel rate ratio is direct).  The key
+    names the KIND the battery actually measured — an edge measured on
+    the VMEM-resident ml_pallas kernel must not govern the streaming
+    XLA 'ml' form, whose per-voxel rate is different.  (None, None)
+    when either side is missing, the kind is unrecognized, or the
+    result is out of range."""
+    ml = data.get("refined3_ml") or {}
+    bx = data.get("refined3_boxed") or {}
+    key = {"ml_pallas": "ml_pallas_boxed_edge",
+           "ml": "ml_boxed_edge"}.get(ml.get("path"))
+    if key is None or bx.get("path") != "boxed":
+        return None, None
+    try:
+        ml_vox = ml["updates_per_s"] / ml["n_cells"] * ml["flat_n_vox"]
+        bx_vox = bx["updates_per_s"] / bx["n_cells"] * bx["boxed_vol"]
+    except (KeyError, TypeError, ZeroDivisionError):
+        return None, None
+    if not (ml_vox > 0 and bx_vox > 0):
+        return None, None
+    rec = round(0.8 * ml_vox / bx_vox, 2)
+    print(f"\n{ml['path']} / boxed per-voxel edge (refined3 pair): "
+          f"{ml_vox / bx_vox:.2f} -> recommended {key} {rec}")
+    return (key, rec) if 0.5 <= rec <= 100.0 else (None, None)
 
 
 if __name__ == "__main__":
