@@ -61,6 +61,71 @@ def _flat_boxed_edge() -> float:
     return _calibrated_edge("flat_boxed_edge", 2.0)
 
 
+def build_face_tables(grid, hood_id, tables, dtype):
+    """Classify each neighbor entry as a face neighbor with a signed
+    direction, reproducing the offset logic of ``solve.hpp:71-123``
+    (overlap in exactly 2 dims + contact in 1), plus the physical
+    factors every finite-volume workload prices faces with.  Shared by
+    Advection and the AMR Vlasov path.  Returns ``(host, dev)``: numpy
+    tables {face_dir, min_area, cell_axis_len, nbr_axis_len,
+    inv_volume} and the device dict (axis_idx included) for jitted
+    steps."""
+    from ..core.neighbors import face_directions
+
+    epoch = grid.epoch
+    hood = epoch.hoods[hood_id]
+    off = hood.nbr_offset.astype(np.int64)          # [D, R, K, 3]
+    nlen = hood.nbr_len.astype(np.int64)            # [D, R, K]
+    clen = epoch.cell_len.astype(np.int64)[..., None]  # [D, R, 1]
+    valid = hood.nbr_valid
+
+    direction = np.where(
+        valid, face_directions(off, clen, nlen), 0
+    ).astype(np.int8)                                # [D, R, K] signed axis or 0
+
+    # physical areas/volumes from geometry tables
+    length = np.asarray(tables.length)               # [D, R, 3]
+    vol = length.prod(axis=-1)                       # [D, R]
+    # gather neighbor physical lengths host-side
+    D, R, K = hood.nbr_rows.shape
+    nb = hood.nbr_rows
+    nlen_phys = length[np.arange(D)[:, None, None], nb]  # [D, R, K, 3]
+
+    axis_idx = np.abs(direction).astype(np.int64) - 1    # [D, R, K]
+    ai = np.maximum(axis_idx, 0)
+    other = np.stack([(ai + 1) % 3, (ai + 2) % 3], axis=-1)
+    cell_area = np.take_along_axis(
+        np.broadcast_to(length[:, :, None], nlen_phys.shape), other, axis=-1
+    ).prod(axis=-1)
+    nbr_area = np.take_along_axis(nlen_phys, other, axis=-1).prod(axis=-1)
+    min_area = np.minimum(cell_area, nbr_area)
+    is_face = direction != 0
+    host = {
+        "face_dir": direction,
+        "min_area": np.where(is_face, min_area, 0.0),
+        # axis lengths for face-velocity interpolation
+        "cell_axis_len": np.take_along_axis(
+            np.broadcast_to(length[:, :, None], nlen_phys.shape),
+            ai[..., None], axis=-1,
+        )[..., 0],
+        "nbr_axis_len": np.take_along_axis(
+            nlen_phys, ai[..., None], axis=-1
+        )[..., 0],
+        "inv_volume": np.where(vol > 0, 1.0 / vol, 0.0),
+    }
+    mesh = grid.mesh
+    put = lambda a, dt: put_table(a, mesh, dt)
+    dev = {
+        "face_dir": put(host["face_dir"], jnp.int8),
+        "min_area": put(host["min_area"], dtype),
+        "cell_axis_len": put(host["cell_axis_len"], dtype),
+        "nbr_axis_len": put(host["nbr_axis_len"], dtype),
+        "inv_volume": put(host["inv_volume"], dtype),
+        "axis_idx": put(ai, jnp.int8),
+    }
+    return host, dev
+
+
 def _ml_boxed_edge(kind: str) -> float:
     """Multi-level (3+ level) whole-run edge, per FORM: the
     VMEM-resident Pallas kernel and the streaming XLA pyramid have
@@ -142,59 +207,15 @@ class Advection:
     # ------------------------------------------------------ static tables
 
     def _build_face_tables(self):
-        """Classify each neighbor entry as a face neighbor with a signed
-        direction, reproducing the offset logic of
-        ``solve.hpp:71-123``: overlap in exactly 2 dims + contact in 1."""
-        from ..core.neighbors import face_directions
-
-        epoch = self.grid.epoch
-        hood = epoch.hoods[self.hood_id]
-        off = hood.nbr_offset.astype(np.int64)          # [D, R, K, 3]
-        nlen = hood.nbr_len.astype(np.int64)            # [D, R, K]
-        clen = epoch.cell_len.astype(np.int64)[..., None]  # [D, R, 1]
-        valid = hood.nbr_valid
-
-        direction = np.where(valid, face_directions(off, clen, nlen), 0).astype(
-            np.int8
+        host, dev = build_face_tables(
+            self.grid, self.hood_id, self.tables, self.dtype
         )
-        self.face_dir = direction                        # [D, R, K] signed axis or 0
-
-        # physical areas/volumes from geometry tables
-        length = np.asarray(self.tables.length)          # [D, R, 3]
-        vol = length.prod(axis=-1)                       # [D, R]
-        # gather neighbor physical lengths host-side
-        D, R, K = hood.nbr_rows.shape
-        nb = hood.nbr_rows
-        nlen_phys = length[np.arange(D)[:, None, None], nb]  # [D, R, K, 3]
-
-        axis_idx = np.abs(direction).astype(np.int64) - 1    # [D, R, K]
-        ai = np.maximum(axis_idx, 0)
-        other = np.stack([(ai + 1) % 3, (ai + 2) % 3], axis=-1)
-        cell_area = np.take_along_axis(
-            np.broadcast_to(length[:, :, None], nlen_phys.shape), other, axis=-1
-        ).prod(axis=-1)
-        nbr_area = np.take_along_axis(nlen_phys, other, axis=-1).prod(axis=-1)
-        min_area = np.minimum(cell_area, nbr_area)
-        is_face = direction != 0
-        self.min_area = np.where(is_face, min_area, 0.0)
-        # axis lengths for face-velocity interpolation
-        self.cell_axis_len = np.take_along_axis(
-            np.broadcast_to(length[:, :, None], nlen_phys.shape), ai[..., None], axis=-1
-        )[..., 0]
-        self.nbr_axis_len = np.take_along_axis(nlen_phys, ai[..., None], axis=-1)[..., 0]
-        self.inv_volume = np.where(vol > 0, 1.0 / vol, 0.0)
-
-        mesh = self.grid.mesh
-        put = lambda a, dt: put_table(a, mesh, dt)
-        dtype = self.dtype
-        self._dev = {
-            "face_dir": put(self.face_dir, jnp.int8),
-            "min_area": put(self.min_area, dtype),
-            "cell_axis_len": put(self.cell_axis_len, dtype),
-            "nbr_axis_len": put(self.nbr_axis_len, dtype),
-            "inv_volume": put(self.inv_volume, dtype),
-            "axis_idx": put(ai, jnp.int8),
-        }
+        self.face_dir = host["face_dir"]
+        self.min_area = host["min_area"]
+        self.cell_axis_len = host["cell_axis_len"]
+        self.nbr_axis_len = host["nbr_axis_len"]
+        self.inv_volume = host["inv_volume"]
+        self._dev = dev
 
     # -------------------------------------------------------------- kernels
 
