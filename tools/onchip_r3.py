@@ -168,7 +168,32 @@ def battery():
     return all(done(k) for k in MEASUREMENTS)
 
 
+def _recalibrate():
+    """Run tools/recalibrate.py --write so a completed battery turns
+    into dispatch constants without operator attention (the tunnel may
+    drop again before anyone looks).  Never raises: a recalibration
+    failure must not take down a watcher whose battery just landed."""
+    try:
+        r = subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "recalibrate.py"),
+             "--write"],
+            text=True, capture_output=True, timeout=120,
+        )
+        msg = (r.stdout + r.stderr).strip()[-400:]
+    except (subprocess.TimeoutExpired, OSError) as e:
+        msg = f"FAILED: {e}"
+    print("[onchip] recalibrate:", msg, flush=True)
+
+
+def _complete(auto_recal: bool):
+    """The single battery-completion sequence for every exit site."""
+    print("[onchip] battery complete:", OUT, flush=True)
+    if auto_recal:
+        _recalibrate()
+
+
 def main():
+    auto_recal = "--then-recalibrate" in sys.argv
     if "--watch" in sys.argv:
         i = sys.argv.index("--watch") + 1
         hours = 8.0
@@ -180,12 +205,12 @@ def main():
         deadline = time.time() + hours * 3600
         while time.time() < deadline:
             if all(done(k) for k in MEASUREMENTS):
-                print("[onchip] battery complete:", OUT, flush=True)
+                _complete(auto_recal)
                 return
             if tunnel_up():
                 print("[onchip] tunnel up; running battery", flush=True)
                 if battery():
-                    print("[onchip] battery complete:", OUT, flush=True)
+                    _complete(auto_recal)
                     return
             else:
                 print("[onchip] tunnel down; sleeping", flush=True)
@@ -193,7 +218,7 @@ def main():
         print("[onchip] watch deadline reached", flush=True)
         return
     if battery():
-        print("[onchip] battery complete:", OUT, flush=True)
+        _complete(auto_recal)
 
 
 if __name__ == "__main__":
