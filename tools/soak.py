@@ -287,16 +287,19 @@ def one(seed):
     rng = np.random.default_rng(seed)
     n = int(rng.choice([4, 6, 8]))
     n_dev = int(rng.choice([1, 2, 4, 8]))
+    maxref = int(rng.choice([1, 2]))   # up to 3 leaf levels
     g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(1)
-         .set_periodic(True, True, True).set_maximum_refinement_level(1)
+         .set_periodic(True, True, True)
+         .set_maximum_refinement_level(maxref)
          .set_geometry(CartesianGeometry, start=(0.,0.,0.),
                        level_0_cell_length=(1./n,)*3)
          .initialize(mesh=make_mesh(n_devices=n_dev)))
-    if rng.random() < 0.5:
-        ids = g.get_cells()
-        for cid in rng.choice(ids, size=len(ids)//6 + 1, replace=False):
-            g.refine_completely(int(cid))
-        g.stop_refining()
+    if rng.random() < 0.7:
+        for _round in range(maxref):
+            ids = g.get_cells()
+            for cid in rng.choice(ids, size=len(ids)//6 + 1, replace=False):
+                g.refine_completely(int(cid))
+            g.stop_refining()
     npart = int(rng.integers(200, 1500))
     m = Particles(g, max_particles_per_cell=256)
     # uniform Cartesian fully-periodic grids — refined or not — must
