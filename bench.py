@@ -1070,12 +1070,15 @@ def _emit_fallback(diag):
                      "no accelerator number could be produced at bench "
                      "time",
             "diagnostics": diag,
-            # Real-chip numbers from the last full on-chip bench run of
-            # this round's code (TPU v5 lite through the tunnel,
-            # 2026-07-30 ~15:00 UTC, before a multi-hour tunnel outage),
-            # recorded so an outage at bench time does not erase the
-            # round's measured state:
+            # Real-chip numbers from the LAST SUCCESSFUL on-chip bench
+            # (TPU v5 lite through the tunnel, 2026-07-30 ~15:00 UTC,
+            # round 3) — the tunnel has been down through rounds 4 and 5,
+            # so every kernel landed since is unmeasured on chip (see
+            # round4/round5_changes keys; the watcher measures the
+            # moment the tunnel answers).  Recorded so an outage at
+            # bench time does not erase the last measured state:
             "last_measured_this_round": {
+                "vintage": "round 3 (2026-07-30); tunnel down since",
                 "headline_median_updates_per_s_per_chip": 4.879e10,
                 "headline_best_updates_per_s_per_chip": 5.138e10,
                 "headline_times_s_8rep": [0.1168, 0.1031, 0.1095, 0.1043,
