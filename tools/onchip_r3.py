@@ -32,11 +32,18 @@ def _ok(value, key=None):
     """A measurement is complete when it is not an error record: no
     "error" key, and — for the sweep, whose values are per-shape rates
     or error strings — no string-valued entries.  Regular measurements
-    legitimately contain strings ("path", "device_kind", notes)."""
+    legitimately contain strings ("path", "device_kind", notes).
+
+    A record whose own "platform" says "cpu" is NOT a measurement: it
+    means jax silently initialized on the host after the tunnel dropped
+    between the tunnel_up() probe and the child, and the number is a
+    CPU rate that must not be persisted as on-chip evidence."""
     if value is None:
         return False
     if isinstance(value, dict):
         if "error" in value:
+            return False
+        if value.get("platform") == "cpu":
             return False
         if key == SWEEP_KEY:
             return all(not isinstance(v, str) for v in value.values())
@@ -46,6 +53,22 @@ def _ok(value, key=None):
 def record(key, value):
     data = _load()
     prev = data.get(key)
+    if key != SWEEP_KEY and isinstance(value, dict) and "error" not in value:
+        # vintage stamp: bench.py's outage fallback promotes the headline
+        # only when the measurement is fresh (same-round), so every
+        # successful record carries its wall-clock time.  The sweep map
+        # holds only per-shape rates — a string stamp there would trip
+        # _ok's string check and the merge logic.
+        value.setdefault(
+            "measured_at",
+            time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    if _ok(prev, key) and not _ok(value, key) and key != SWEEP_KEY:
+        # a failed/cpu-fallback child must not clobber persisted on-chip
+        # evidence (e.g. a concurrent runner racing the watcher); the
+        # sweep's per-shape merge above already preserves its shapes
+        print(f"[onchip] {key}: keeping prior record "
+              "(new result incomplete)", flush=True)
+        return
     if (key == SWEEP_KEY and not _ok(value, key)
             and isinstance(prev, dict) and isinstance(value, dict)):
         # merge sweep passes: a shape measured on an earlier pass
