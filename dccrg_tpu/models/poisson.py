@@ -76,9 +76,10 @@ class Poisson:
 
     def _build_flat(self):
         """Dense flat-voxel operator (ops/flat_poisson.py) — engaged when
-        the grid qualifies (Cartesian, levels ⊆ {0, 1}; multi-device when
-        ownership is the voxel z-slab partition); the gather tables
-        remain the general path and the oracle."""
+        the grid qualifies (Cartesian, leaf levels ≤ flat_amr._ML_MAX_VL
+        = 4 via the inflated-voxel layout; multi-device when ownership is
+        the voxel z-slab partition); the gather tables remain the general
+        path and the oracle for deeper refinement."""
         from ..ops.flat_poisson import (
             build_flat_poisson,
             make_flat_poisson_apply,

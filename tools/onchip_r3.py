@@ -242,6 +242,12 @@ def main():
                 if battery():
                     _complete(auto_recal)
                     return
+                if auto_recal:
+                    # partial pass: recalibrate from whatever landed —
+                    # recalibrate.py refuses to write when the needed
+                    # keys (refined_boxed + sweep) are missing, so this
+                    # is safe to attempt after every window
+                    _recalibrate()
             else:
                 print("[onchip] tunnel down; sleeping", flush=True)
             time.sleep(300)
@@ -249,6 +255,8 @@ def main():
         return
     if battery():
         _complete(auto_recal)
+    elif auto_recal:
+        _recalibrate()
 
 
 if __name__ == "__main__":
