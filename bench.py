@@ -459,15 +459,18 @@ def measure_pic() -> dict:
 
 
 def measure_poisson(allow_flat: bool = True, use_pallas: bool = True,
-                    include_uniform: bool = True) -> dict:
+                    include_uniform: bool = True,
+                    allow_rolled: bool = True) -> dict:
     """BASELINE.md config 3: iterative Poisson solve on a refined grid —
     reports solver cell-iterations/s (matrix-free BiCG sweeps are the
     reference's hot loop, tests/poisson/poisson_solve.hpp).
 
-    ``allow_flat=False, use_pallas=False`` measures the general
-    gather-table path on the SAME config (the VERDICT-r3 attribution);
-    the kwargs keep this function the single source of truth for the
-    configuration."""
+    ``allow_flat=False, use_pallas=False, allow_rolled=False`` measures
+    the raw general gather-table path on the SAME config (the VERDICT-r3
+    attribution); with ``allow_rolled=True`` it measures the rolled
+    static-offset decomposition of the same operator
+    (ops/rolled_gather.py).  The kwargs keep this function the single
+    source of truth for the configuration."""
     import jax
     import numpy as np
 
@@ -500,7 +503,8 @@ def measure_poisson(allow_flat: bool = True, use_pallas: bool = True,
     rhs -= rhs.mean()
 
     p = Poisson(g, dtype=np.float32, allow_flat=allow_flat,
-                use_pallas=use_pallas)  # f32: the TPU-native precision
+                use_pallas=use_pallas,  # f32: the TPU-native precision
+                allow_rolled=allow_rolled)
     state = p.initialize_state(rhs)
     iters = 60
     # warmup/compile
@@ -525,7 +529,8 @@ def measure_poisson(allow_flat: bool = True, use_pallas: bool = True,
         "cell_iterations_per_s": n_cells * it_ran / secs,
         "times_s": [round(t, 4) for t in times],
         "path": ("fused" if p._solve_fast is not None
-                 else "flat" if p._flat is not None else "gather"),
+                 else "flat" if p._flat is not None
+                 else "rolled" if p._rolled is not None else "gather"),
     }
     if p._flat is None:
         # gather-path attribution data: the table shapes that set the

@@ -47,7 +47,7 @@ class Poisson:
 
     def __init__(self, grid, hood_id=None, dtype=None,
                  solve_cells=None, skip_cells=None, allow_flat=True,
-                 use_pallas=True):
+                 use_pallas=True, allow_rolled=True):
         #: use_pallas follows the Advection convention: True = compiled
         #: kernels on TPU only; "interpret" = Pallas interpreter
         #: (CI/CPU coverage); False = XLA only
@@ -71,6 +71,12 @@ class Poisson:
         self._build_factors()
         self._flat_tables = None
         self._flat = self._build_flat() if allow_flat else None
+        # rolled static-offset matvec (ops/rolled_gather.py): replaces
+        # the [R, K] row gather in the general-path solver when the flat
+        # operator does not engage; the raw gather (_apply) remains the
+        # operator oracle and the residual() diagnostic
+        self._rolled = (self._build_rolled()
+                        if allow_rolled and self._flat is None else None)
         self._solve = self._build_solver()
         self._solve_fast = self._build_fast_solver()
 
@@ -240,6 +246,7 @@ class Poisson:
         # O(R*K) * 2 device memory as a diagnostics-only oracle
         self._mult_np = (mult_fwd, mult_rev)
         self._mult_dev = None
+        self._scaling_np = scaling_rows
         self._volume = put(np.asarray(self.tables.length).prod(-1))
         solve_rows = np.asarray(self.tables.local_mask) & (
             type_rows == self.SOLVE_CELL
@@ -278,6 +285,37 @@ class Poisson:
         xn = gather_neighbors(x, self.tables.nbr_rows)
         return self._scaling * x + ordered_sum(mult * xn, axis=-1), x
 
+    def _build_rolled(self):
+        """(apply_fwd, apply_rev) on the rolled static-offset operator
+        (ops/rolled_gather.py), or None when ineligible (multi-device —
+        ghost rows break the single-array roll space) or when the offset
+        histogram refuses the decomposition.  Semantically identical to
+        ``_apply`` up to fp association (per-offset accumulation instead
+        of the slot-ordered reduction)."""
+        if self.grid.epoch.n_devices != 1:
+            return None
+        from ..ops.rolled_gather import (
+            build_rolled_matvec,
+            make_rolled_apply,
+        )
+
+        nbr = np.asarray(self.tables.nbr_rows)[0]
+        applies = []
+        for mult in self._mult_np:
+            t = build_rolled_matvec(nbr, mult[0], self._scaling_np[0])
+            if t is None:
+                return None
+            applies.append(make_rolled_apply(t, jnp.dtype(self.dtype)))
+
+        def wrap(ap):
+            def run(x):
+                x = self._exchange({"v": x})["v"]
+                return ap(x[0])[None]
+
+            return run
+
+        return wrap(applies[0]), wrap(applies[1])
+
     def _build_solver(self):
         """The BiCG loop, built over one of two operator spaces: the
         general gather tables ([1, R] rows) or the flat voxel grid when
@@ -292,9 +330,12 @@ class Poisson:
         else:
             solve_mask = self._solve_mask
             dot_mask = solve_mask
-            mult_fwd, mult_rev = self._mult_tables()
-            apply_fwd = lambda v: self._apply(v, mult_fwd)[0]
-            apply_rev = lambda v: self._apply(v, mult_rev)[0]
+            if self._rolled is not None:
+                apply_fwd, apply_rev = self._rolled
+            else:
+                mult_fwd, mult_rev = self._mult_tables()
+                apply_fwd = lambda v: self._apply(v, mult_fwd)[0]
+                apply_rev = lambda v: self._apply(v, mult_rev)[0]
             # boundary cells keep their given solution values: they feed
             # the initial residual (Dirichlet lifting) but never change
             lift = lambda row_arr: jnp.where(local, row_arr, 0.0)

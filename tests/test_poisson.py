@@ -299,7 +299,7 @@ def test_flat_path_matches_gather_refined():
 
     p_flat = Poisson(g)
     assert p_flat._flat is not None, "flat path must engage"
-    p_gather = Poisson(g, allow_flat=False)
+    p_gather = Poisson(g, allow_flat=False, allow_rolled=False)
     assert p_gather._flat is None
 
     s0 = p_flat.initialize_state(rhs)
@@ -340,7 +340,7 @@ def test_flat_path_three_levels_matches_gather(n_dev):
     assert p_flat._flat is not None, "flat path must engage at 3 levels"
     assert p_flat._flat_tables["vl"] == 2
     assert p_flat._solve_fast is None
-    p_gather = Poisson(g, allow_flat=False, use_pallas=False)
+    p_gather = Poisson(g, allow_flat=False, use_pallas=False, allow_rolled=False)
 
     # operator identity on a random vector, forward and transpose
     rng = np.random.default_rng(1)
@@ -388,7 +388,7 @@ def test_flat_path_matches_gather_uniform_with_roles():
     kw = dict(solve_cells=solve, skip_cells=skip)
     p_flat = Poisson(g, **kw)
     assert p_flat._flat is not None
-    p_gather = Poisson(g, allow_flat=False, **kw)
+    p_gather = Poisson(g, allow_flat=False, allow_rolled=False, **kw)
 
     s0 = p_flat.grid.new_state(p_flat.spec)
     s0 = g.set_cell_data(s0, "rhs", cells, rhs)
@@ -416,7 +416,7 @@ def test_flat_path_periodic_self_coupling():
 
     p_flat = Poisson(g)
     assert p_flat._flat is not None
-    p_gather = Poisson(g, allow_flat=False)
+    p_gather = Poisson(g, allow_flat=False, allow_rolled=False)
 
     s0 = p_flat.initialize_state(rhs)
     out_f, _, _ = p_flat.solve(s0, max_iterations=100, stop_residual=1e-13)
@@ -438,7 +438,7 @@ def test_flat_path_periodic_self_coupling():
 
     q_flat = Poisson(g2)
     assert q_flat._flat is not None
-    q_gather = Poisson(g2, allow_flat=False)
+    q_gather = Poisson(g2, allow_flat=False, allow_rolled=False)
     s2 = q_flat.initialize_state(rhs2)
     o_f, _, _ = q_flat.solve(s2, max_iterations=200, stop_residual=1e-13)
     o_g, _, _ = q_gather.solve(s2, max_iterations=200, stop_residual=1e-13)

@@ -1,0 +1,203 @@
+"""Rolled static-offset matvec (ops/rolled_gather.py) and its Poisson
+integration.
+
+The general gather-path operator has static structure, so it decomposes
+into dense roll terms + a small exception COO (the flat voxel path's
+roll trick generalized to any static sparsity).  The decomposition must
+be exactly the same operator: these tests compare it entry-for-entry
+against brute force and against the gather-table ``_apply`` oracle on
+refined grids (mirroring the reference's solver-vs-direct checks,
+``tests/poisson/poisson1d.cpp`` style).
+"""
+import jax
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+from dccrg_tpu.models import Poisson
+from dccrg_tpu.ops.rolled_gather import build_rolled_matvec, make_rolled_apply
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _brute(nbr, mult, scaling, x):
+    return scaling * x + (mult * x[nbr]).sum(-1)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_matvec_matches_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    R = int(rng.integers(8, 400))
+    K = int(rng.integers(1, 9))
+    nbr = rng.integers(0, R, (R, K))
+    mult = rng.standard_normal((R, K))
+    mult[rng.random((R, K)) < 0.4] = 0.0
+    # concentrate most entries on a short offset head (the leaf-order
+    # structure the decomposition exploits), keep a random tail
+    for k in range(K):
+        o = int(rng.integers(-4, 5))
+        rows = np.arange(R)
+        tgt = rows + o
+        ok = (rng.random(R) < 0.8) & (tgt >= 0) & (tgt < R)
+        nbr[ok, k] = tgt[ok]
+    scaling = rng.standard_normal(R)
+    x = rng.standard_normal(R)
+    ref = _brute(nbr, mult, scaling, x)
+
+    t = build_rolled_matvec(nbr, mult, scaling, max_exc_frac=1.0)
+    assert t is not None
+    y = np.asarray(make_rolled_apply(t, np.float64)(x))
+    assert np.abs(y - ref).max() < 1e-13 * max(1.0, np.abs(ref).max())
+
+    # exception-heavy split of the same operator is still the operator
+    t2 = build_rolled_matvec(nbr, mult, scaling, max_terms=2,
+                             max_exc_frac=1.0)
+    y2 = np.asarray(make_rolled_apply(t2, np.float64)(x))
+    assert np.abs(y2 - ref).max() < 1e-13 * max(1.0, np.abs(ref).max())
+
+
+def test_build_refusals_and_degenerate():
+    rng = np.random.default_rng(7)
+    R, K = 256, 6
+    scaling = rng.standard_normal(R)
+    # scattered indices, tight exception budget: refuse
+    nbr = rng.integers(0, R, (R, K))
+    assert build_rolled_matvec(nbr, np.ones((R, K)), scaling,
+                               max_exc_frac=0.01) is None
+    # pure-diagonal system: zero terms, zero exceptions
+    t = build_rolled_matvec(nbr, np.zeros((R, K)), scaling)
+    x = rng.standard_normal(R)
+    assert np.allclose(np.asarray(make_rolled_apply(t, np.float64)(x)),
+                       scaling * x)
+    assert t["offsets"] == [] and t["exc_r"].size == 0
+
+
+def _refined_grid(n=8, n_devices=1, maxref=1, periodic=(True, True, True)):
+    g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
+         .set_periodic(*periodic).set_maximum_refinement_level(maxref)
+         .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                       level_0_cell_length=(1.0 / n,) * 3)
+         .initialize(mesh=make_mesh(n_devices=n_devices)))
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    r = np.linalg.norm(c - 0.5, axis=1)
+    for cid in ids[r < 0.3]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    return g
+
+
+@pytest.mark.parametrize("periodic", [(True, True, True),
+                                      (False, True, False)])
+def test_rolled_matches_gather_operator_on_grid(periodic):
+    g = _refined_grid(periodic=periodic)
+    ids = g.get_cells()
+    pr = Poisson(g, allow_flat=False)
+    pg = Poisson(g, allow_flat=False, allow_rolled=False)
+    assert pr._rolled is not None and pg._rolled is None
+
+    rng = np.random.default_rng(3)
+    mf, mr = pg._mult_tables()
+    for _ in range(3):
+        v = rng.standard_normal(len(ids))
+        s = g.new_state(pg.spec)
+        x = g.set_cell_data(s, "solution", ids, v)["solution"]
+        for mult, rolled in ((mf, pr._rolled[0]), (mr, pr._rolled[1])):
+            a_g = np.asarray(pg._apply(x, mult)[0])
+            a_r = np.asarray(rolled(x))
+            assert np.abs(a_g - a_r).max() < 1e-12 * max(
+                1.0, np.abs(a_g).max())
+
+
+def test_rolled_solver_tracks_gather_solver():
+    g = _refined_grid()
+    ids = g.get_cells()
+    c = g.geometry.get_center(ids)
+    rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
+    rhs -= rhs.mean()
+    pr = Poisson(g, allow_flat=False)
+    pg = Poisson(g, allow_flat=False, allow_rolled=False)
+    st = pr.initialize_state(rhs)
+    sol_r, res_r, it_r = pr.solve(st, max_iterations=100,
+                                  stop_residual=1e-8)
+    sol_g, res_g, it_g = pg.solve(st, max_iterations=100,
+                                  stop_residual=1e-8)
+    # the operators differ in fp association, so a residual landing
+    # within an ulp of a stopping rule can split the trajectories by
+    # one iteration (same ±1 convention as the flat-vs-gather tests)
+    assert abs(int(it_r) - int(it_g)) <= 1
+    # both solutions judged under the SAME independent gather residual
+    rr = float(pg.residual(sol_r))
+    rg = float(pg.residual(sol_g))
+    assert rr <= 10.0 * rg + 1e-9 and rg <= 10.0 * rr + 1e-9
+    if int(it_r) == int(it_g):
+        assert float(res_r) == pytest.approx(float(res_g), rel=1e-8)
+        d = np.abs(np.asarray(sol_r["solution"])
+                   - np.asarray(sol_g["solution"])).max()
+        assert d < 1e-8
+    # the independent residual() diagnostic still runs the raw gather
+    assert float(pr.residual(sol_r)) == pytest.approx(float(res_r),
+                                                      rel=1e-6)
+
+
+def test_rolled_respects_cell_roles():
+    g = _refined_grid()
+    ids = g.get_cells()
+    rng = np.random.default_rng(11)
+    skip = rng.choice(ids, size=len(ids) // 8, replace=False)
+    pr = Poisson(g, allow_flat=False, skip_cells=skip)
+    pg = Poisson(g, allow_flat=False, allow_rolled=False, skip_cells=skip)
+    assert pr._rolled is not None
+    rhs = rng.standard_normal(len(ids))
+    st = pr.initialize_state(rhs)
+    sol_r, res_r, it_r = pr.solve(st, max_iterations=50,
+                                  stop_residual=1e-8)
+    sol_g, res_g, it_g = pg.solve(st, max_iterations=50,
+                                  stop_residual=1e-8)
+    assert abs(int(it_r) - int(it_g)) <= 1  # fp-association tolerance
+    rr = float(pg.residual(sol_r))
+    rg = float(pg.residual(sol_g))
+    assert rr <= 10.0 * rg + 1e-9 and rg <= 10.0 * rr + 1e-9
+
+
+def test_rolled_disabled_on_multi_device():
+    g = _refined_grid(n_devices=2)
+    p = Poisson(g, allow_flat=False)
+    assert p._rolled is None  # ghost rows break the single roll space
+
+
+def test_rolled_engages_on_stretched_geometry():
+    """The real beneficiary: the flat voxel layout always refuses
+    stretched geometry, so before the rolled operator these grids paid
+    the raw gather (reference supports Poisson on any geometry via the
+    same factor cache, poisson_solve.hpp:716-745)."""
+    from dccrg_tpu.geometry.stretched import StretchedCartesianGeometry
+
+    n = 10
+    coords = [np.cumsum(np.concatenate([[0.0],
+                                        np.linspace(0.5, 1.5, n)]))
+              for _ in range(3)]
+    g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
+         .set_periodic(False, False, False).set_maximum_refinement_level(1)
+         .set_geometry(StretchedCartesianGeometry, coordinates=coords)
+         .initialize(mesh=make_mesh(n_devices=1)))
+    ids = g.get_cells()
+    for cid in ids[:40]:
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    ids = g.get_cells()
+
+    pr = Poisson(g)
+    pg = Poisson(g, allow_rolled=False)
+    assert pr._flat is None and pr._rolled is not None
+
+    rng = np.random.default_rng(0)
+    mf, mr = pg._mult_tables()
+    v = rng.standard_normal(len(ids))
+    x = g.set_cell_data(g.new_state(pg.spec), "solution", ids,
+                        v)["solution"]
+    for mult, rolled in ((mf, pr._rolled[0]), (mr, pr._rolled[1])):
+        a_g = np.asarray(pg._apply(x, mult)[0])
+        a_r = np.asarray(rolled(x))
+        assert np.abs(a_g - a_r).max() < 1e-12 * max(1.0,
+                                                     np.abs(a_g).max())

@@ -130,6 +130,17 @@ MEASUREMENTS = {
     "headline": ("import bench\nprint(json.dumps(bench.measure_tpu()))", 1500),
     "poisson": ("import bench\nprint(json.dumps(bench.measure_poisson()))",
                 1500),
+    # the rolled static-offset decomposition of the SAME general
+    # operator (ops/rolled_gather.py) — the round-5 fix candidate for
+    # the gather path's 0.13x showing
+    "poisson_rolled": ("""
+import bench
+out = bench.measure_poisson(allow_flat=False, use_pallas=False,
+                            include_uniform=False, allow_rolled=True)
+out["device_kind"] = jax.devices()[0].device_kind
+out["platform"] = jax.devices()[0].platform
+print(json.dumps(out))
+""", 1500),
     "gol": ("import bench\nprint(json.dumps(bench.measure_gol()))", 1500),
     "refined_dispatch": (
         "import bench\nprint(json.dumps(bench.measure_refined()))", 1500),
@@ -152,8 +163,9 @@ MEASUREMENTS = {
     "poisson_gather": ("""
 import bench
 out = bench.measure_poisson(allow_flat=False, use_pallas=False,
-                            include_uniform=False)
+                            include_uniform=False, allow_rolled=False)
 out["device_kind"] = jax.devices()[0].device_kind
+out["platform"] = jax.devices()[0].platform
 print(json.dumps(out))
 """, 1500),
     "poisson3": ("import bench\nprint(json.dumps(bench.measure_poisson3()))",

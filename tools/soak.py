@@ -616,9 +616,28 @@ def one(seed):
             sel[0] = True
         kw['solve_cells'] = cells[sel]
     pf = Poisson(g, **kw)
+    pg = Poisson(g, allow_flat=False, allow_rolled=False, **kw)  # raw oracle
+
+    # rolled static-offset decomposition (single-device grids): must be
+    # the gather operator entry-for-entry on random vectors.  Checked
+    # BEFORE the flat early-return: flat-refusing grids are exactly the
+    # rolled path's production audience (poisson.py builds it only when
+    # _flat is None)
+    prl = Poisson(g, allow_flat=False, **kw)
+    if prl._rolled is not None:
+        mfo, mro = pg._mult_tables()
+        vro = rng.standard_normal(len(cells))
+        sR = g.new_state(pg.spec)
+        xR = g.set_cell_data(sR, 'solution', cells, vro)['solution']
+        for mult, rolled in ((mfo, prl._rolled[0]), (mro, prl._rolled[1])):
+            a_g = np.asarray(pg._apply(xR, mult)[0])
+            a_r = np.asarray(rolled(xR))
+            ops = max(1.0, np.abs(a_g).max())
+            assert np.abs(a_g - a_r).max() < 1e-10 * ops, (
+                seed, 'rolled', np.abs(a_g - a_r).max(), ops)
     if pf._flat is None:
-        return 'gather-only'
-    pg = Poisson(g, allow_flat=False, **kw)
+        return ('rolled-only' if prl._rolled is not None
+                else 'gather-only')
 
     # operator-level oracle: A.v and A^T.v must agree to fp roundoff on
     # a random vector (BiCG trajectories may legitimately diverge on
@@ -646,11 +665,13 @@ def one(seed):
         # the reference's usage shape: BiCG on these non-normal systems
         # (random roles + AMR) can break down mid-Krylov-space — the
         # restart driver rebuilds the space from the best solution and
-        # recovers (seed 529: 1.4e-5 -> 6.5e-12 in 3 restarts).  Compare
-        # the PATHS under the same driver, not single trajectories,
-        # which legitimately diverge in rounding.
+        # recovers (seed 529: 1.4e-5 -> 6.5e-12 in 3 restarts; seed 61's
+        # 3-level random-role system needs 8 restarts on the ml-flat
+        # path: 4.6e-7 after 4, 7.8e-12 after 8, gather similar).
+        # Compare the PATHS under the same driver, not single
+        # trajectories, which legitimately diverge in rounding.
         st, _r, _i = p.solve(s0, max_iterations=60, stop_residual=1e-11,
-                             restarts=4)
+                             restarts=8)
         return st
 
     of = restarted(pf)
