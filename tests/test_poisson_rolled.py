@@ -92,7 +92,7 @@ def _refined_grid(n=8, n_devices=1, maxref=1, periodic=(True, True, True)):
 def test_rolled_matches_gather_operator_on_grid(periodic):
     g = _refined_grid(periodic=periodic)
     ids = g.get_cells()
-    pr = Poisson(g, allow_flat=False)
+    pr = Poisson(g, allow_flat=False, allow_rolled=True)
     pg = Poisson(g, allow_flat=False, allow_rolled=False)
     assert pr._rolled is not None and pg._rolled is None
 
@@ -115,7 +115,7 @@ def test_rolled_solver_tracks_gather_solver():
     c = g.geometry.get_center(ids)
     rhs = np.sin(2 * np.pi * c[:, 0]) * np.cos(2 * np.pi * c[:, 1])
     rhs -= rhs.mean()
-    pr = Poisson(g, allow_flat=False)
+    pr = Poisson(g, allow_flat=False, allow_rolled=True)
     pg = Poisson(g, allow_flat=False, allow_rolled=False)
     st = pr.initialize_state(rhs)
     sol_r, res_r, it_r = pr.solve(st, max_iterations=100,
@@ -145,7 +145,7 @@ def test_rolled_respects_cell_roles():
     ids = g.get_cells()
     rng = np.random.default_rng(11)
     skip = rng.choice(ids, size=len(ids) // 8, replace=False)
-    pr = Poisson(g, allow_flat=False, skip_cells=skip)
+    pr = Poisson(g, allow_flat=False, allow_rolled=True, skip_cells=skip)
     pg = Poisson(g, allow_flat=False, allow_rolled=False, skip_cells=skip)
     assert pr._rolled is not None
     rhs = rng.standard_normal(len(ids))
@@ -162,7 +162,7 @@ def test_rolled_respects_cell_roles():
 
 def test_rolled_disabled_on_multi_device():
     g = _refined_grid(n_devices=2)
-    p = Poisson(g, allow_flat=False)
+    p = Poisson(g, allow_flat=False, allow_rolled=True)
     assert p._rolled is None  # ghost rows break the single roll space
 
 
@@ -187,7 +187,7 @@ def test_rolled_engages_on_stretched_geometry():
     g.stop_refining()
     ids = g.get_cells()
 
-    pr = Poisson(g)
+    pr = Poisson(g, allow_rolled=True)
     pg = Poisson(g, allow_rolled=False)
     assert pr._flat is None and pr._rolled is not None
 

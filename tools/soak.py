@@ -623,7 +623,7 @@ def one(seed):
     # BEFORE the flat early-return: flat-refusing grids are exactly the
     # rolled path's production audience (poisson.py builds it only when
     # _flat is None)
-    prl = Poisson(g, allow_flat=False, **kw)
+    prl = Poisson(g, allow_flat=False, allow_rolled=True, **kw)
     if prl._rolled is not None:
         mfo, mro = pg._mult_tables()
         vro = rng.standard_normal(len(cells))
