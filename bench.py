@@ -1206,6 +1206,12 @@ def _emit_fallback(diag):
                     "[D,D,S] all_to_all to per-distance ppermute ring "
                     "steps sized by actual pair counts; wire bytes now "
                     "scale with the real send lists",
+                "rolled_gather": "general Poisson operator decomposed "
+                    "into <=64 static-offset roll terms + exception COO "
+                    "(ops/rolled_gather.py), replacing the scalarized "
+                    "TPU [R,K] gather on flat-refusing grids; battery "
+                    "key poisson_rolled measures it vs poisson_gather "
+                    "(allow_rolled pinned off)",
             },
             "round4_changes_unmeasured_on_chip": {
                 "advection_blocked_direct": "per-step streaming traffic "
