@@ -22,8 +22,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 import numpy as np
 
-from dccrg_tpu import Grid, make_mesh
-from dccrg_tpu.geometry.stretched import StretchedCartesianGeometry
+from dccrg_tpu import Grid, StretchedCartesianGeometry, make_mesh
 from dccrg_tpu.models import Poisson
 
 
