@@ -296,30 +296,32 @@ class Poisson:
 
     def _build_rolled(self):
         """(apply_fwd, apply_rev) on the rolled static-offset operator
-        (ops/rolled_gather.py), or None when ineligible (multi-device —
-        ghost rows break the single-array roll space) or when the offset
-        histogram refuses the decomposition.  Semantically identical to
-        ``_apply`` up to fp association (per-offset accumulation instead
-        of the slot-ordered reduction)."""
-        if self.grid.epoch.n_devices != 1:
-            return None
+        (ops/rolled_gather.py), or None when any device's offset
+        histogram refuses the decomposition.  Each device's row block
+        (local + ghost + scratch, ghosts refreshed by the halo exchange
+        first — same contract as ``_apply``) is its own roll space;
+        the union offset set keeps roll amounts trace-time constants.
+        Semantically identical to ``_apply`` up to fp association
+        (per-offset accumulation instead of the slot-ordered
+        reduction)."""
         from ..ops.rolled_gather import (
-            build_rolled_matvec,
-            make_rolled_apply,
+            build_rolled_matvec_multi,
+            make_rolled_apply_multi,
         )
 
-        nbr = np.asarray(self.tables.nbr_rows)[0]
+        nbr = np.asarray(self.tables.nbr_rows)
         applies = []
         for mult in self._mult_np:
-            t = build_rolled_matvec(nbr, mult[0], self._scaling_np[0])
+            t = build_rolled_matvec_multi(nbr, mult, self._scaling_np)
             if t is None:
                 return None
-            applies.append(make_rolled_apply(t, jnp.dtype(self.dtype)))
+            applies.append(make_rolled_apply_multi(
+                t, jnp.dtype(self.dtype), mesh=self.grid.mesh))
 
         def wrap(ap):
             def run(x):
                 x = self._exchange({"v": x})["v"]
-                return ap(x[0])[None]
+                return ap(x)
 
             return run
 

@@ -38,7 +38,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["build_rolled_matvec", "make_rolled_apply"]
+__all__ = ["build_rolled_matvec", "make_rolled_apply",
+           "build_rolled_matvec_multi", "make_rolled_apply_multi"]
 
 #: build_rolled_matvec defaults; exposed for tests and calibration.
 #: A dense term streams 2·R·itemsize per apply regardless of how many
@@ -144,6 +145,98 @@ def make_rolled_apply(tables, dtype):
             y = y + weights[t] * jnp.roll(x, -o)
         if has_exc:
             y = y.at[exc_r].add(exc_w * x[exc_idx])
+        return y
+
+    return apply
+
+
+def build_rolled_matvec_multi(nbr_rows, mult, scaling, *,
+                              max_terms=MAX_TERMS,
+                              min_count_frac=MIN_COUNT_FRAC,
+                              max_exc_frac=MAX_EXC_FRAC):
+    """Sharded-mesh variant: per-device decompositions with a UNION
+    offset set, or None when any device's histogram refuses.
+
+    ``nbr_rows``/``mult``: (D, R, K); ``scaling``: (D, R).  Each
+    device's row block is its own roll space (local + ghost + scratch
+    rows, ghost values refreshed by the halo exchange before the
+    apply, same as the gather path).  Roll amounts must be trace-time
+    constants shared across devices, so the union of the per-device
+    offset heads becomes the term list and a device missing an offset
+    carries zero weights for it.  Exception lists are right-padded per
+    device with zero-weight entries pointing at row 0.
+
+    Returns ``{"offsets", "weights" (D, T, R), "exc_r"/"exc_idx"
+    (D, E), "exc_w" (D, E), "scaling" (D, R)}``.
+    """
+    nbr_rows = np.asarray(nbr_rows)
+    mult = np.asarray(mult)
+    scaling = np.asarray(scaling)
+    D, R, K = nbr_rows.shape
+    per_dev = []
+    for d in range(D):
+        t = build_rolled_matvec(
+            nbr_rows[d], mult[d], scaling[d], max_terms=max_terms,
+            min_count_frac=min_count_frac, max_exc_frac=max_exc_frac)
+        if t is None:
+            return None
+        per_dev.append(t)
+
+    union = sorted({o for t in per_dev for o in t["offsets"]})
+    if len(union) > 2 * max_terms:  # union blow-up across devices
+        return None
+    slot = {o: i for i, o in enumerate(union)}
+    T = len(union)
+    weights = np.zeros((D, T, R), dtype=mult.dtype)
+    for d, t in enumerate(per_dev):
+        for i, o in enumerate(t["offsets"]):
+            weights[d, slot[o]] = t["weights"][i]
+
+    E = max((t["exc_r"].size for t in per_dev), default=0)
+    exc_r = np.zeros((D, E), np.int32)
+    exc_idx = np.zeros((D, E), np.int32)
+    exc_w = np.zeros((D, E), dtype=mult.dtype)
+    for d, t in enumerate(per_dev):
+        n = t["exc_r"].size
+        exc_r[d, :n] = t["exc_r"]
+        exc_idx[d, :n] = t["exc_idx"]
+        exc_w[d, :n] = t["exc_w"]
+
+    return {"offsets": union, "weights": weights, "exc_r": exc_r,
+            "exc_idx": exc_idx, "exc_w": exc_w, "scaling": scaling}
+
+
+def make_rolled_apply_multi(tables, dtype, mesh=None):
+    """Jittable ``apply(x: [D, R]) -> [D, R]`` from
+    ``build_rolled_matvec_multi`` tables.  Every op is device-local
+    under the leading-axis sharding — per-device rolls along the row
+    axis, elementwise weight multiplies, and a per-device batched
+    exception gather/scatter-add — so XLA inserts no collectives
+    (ghost refresh happens in the caller's halo exchange, exactly as
+    on the gather path)."""
+    if mesh is not None:
+        from ..parallel.mesh import put_table
+
+        put = lambda a, dt=None: put_table(a, mesh, dt)
+    else:
+        put = lambda a, dt=None: jnp.asarray(a, dt)
+    offsets = tables["offsets"]
+    weights = put(tables["weights"], dtype)
+    scaling = put(tables["scaling"], dtype)
+    has_exc = tables["exc_r"].shape[1] > 0
+    if has_exc:
+        exc_r = put(tables["exc_r"])
+        exc_idx = put(tables["exc_idx"])
+        exc_w = put(tables["exc_w"], dtype)
+    D = tables["weights"].shape[0]
+    didx = jnp.arange(D)[:, None]
+
+    def apply(x):
+        y = scaling * x
+        for t, o in enumerate(offsets):
+            y = y + weights[:, t] * jnp.roll(x, -o, axis=1)
+        if has_exc:
+            y = y.at[didx, exc_r].add(exc_w * x[didx, exc_idx])
         return y
 
     return apply
