@@ -727,7 +727,11 @@ def run(name: str, lo: int, hi: int) -> bool:
         capture_output=True,
     )
     ok = r.returncode == 0
-    tail = (r.stdout + r.stderr).strip().splitlines()[-1:] or [""]
+    # on success show the body's own stdout marker — stderr may end with
+    # benign XLA advisories (slow constant folding etc.) that would make
+    # an OK line read like a failure
+    src = r.stdout if ok else (r.stdout + r.stderr)
+    tail = src.strip().splitlines()[-1:] or [""]
     print(f"{name:12s} [{lo},{hi}): {'OK' if ok else 'FAIL'}  {tail[0][:90]}")
     if not ok:
         print(r.stdout[-2000:])
