@@ -934,6 +934,12 @@ def _summarize(d: dict) -> dict:
     pick("vlasov_upd_s", "vlasov", "phase_updates_per_s")
     pick("vlasov_vs", "vlasov", "vs_baseline")
     pick("pic_push_s", "pic", "pushes_per_s_incl_migration")
+    if isinstance(d.get("partial"), dict):
+        # recovered mid-bench record: the tail capture must not read as
+        # a complete battery (same explicitness as the fallback flag)
+        s["partial_missing"] = d["partial"].get("missing", [])
+    if "recovery_diagnostics" in d:
+        s["recovered"] = True
     if "error" in d:
         s["fallback"] = True
         pick("battery_headline", "onchip_battery", "headline",
@@ -1029,6 +1035,21 @@ def main():
             "probe_stderr_tail": probe_err,
         })
         return
+    def _last_record(out):
+        """Last stdout line that PARSES as a record: a child killed
+        mid-print leaves a truncated final line, and the complete
+        previous cumulative record right above it must win."""
+        for ln in reversed((out or "").splitlines()):
+            if not ln.startswith("{"):
+                continue
+            try:
+                if isinstance(json.loads(ln), dict):
+                    return ln
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    recovered = None
     try:
         r = subprocess.run(
             [sys.executable, str(pathlib.Path(__file__).resolve()), "--_real"],
@@ -1036,11 +1057,7 @@ def main():
             capture_output=True,
             text=True,
         )
-        line = next(
-            (ln for ln in reversed(r.stdout.splitlines())
-             if ln.startswith("{")),
-            None,
-        )
+        line = _last_record(r.stdout)
         if r.returncode == 0 and line:
             sys.stderr.write(r.stderr)
             try:
@@ -1049,11 +1066,29 @@ def main():
                 print(line)
             return
         diag = {"rc": r.returncode, "stderr_tail": r.stderr[-800:]}
+        recovered = line  # a crashed child may still have emitted partials
     except subprocess.TimeoutExpired as e:
         err = e.stderr or b""
         if isinstance(err, bytes):
             err = err.decode("utf-8", "replace")
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
         diag = {"timeout_s": _REAL_BENCH_TIMEOUT_S, "stderr_tail": err[-800:]}
+        recovered = _last_record(out)
+    if recovered:
+        # the child emits a cumulative record after every measurement:
+        # a mid-bench hang (tunnel drop) or crash still leaves live
+        # accelerator numbers on its stdout — report those, not the
+        # outage fallback
+        try:
+            rec = json.loads(recovered)
+            if isinstance(rec, dict) and rec.get("metric"):
+                rec.setdefault("detail", {})["recovery_diagnostics"] = diag
+                _emit(rec)
+                return
+        except json.JSONDecodeError:
+            pass
     _emit_fallback(diag)
 
 
@@ -1260,23 +1295,49 @@ def _emit_fallback(diag):
     })
 
 
+#: the real bench's per-workload measurements, in the order they run —
+#: quick/high-value first so a mid-bench tunnel drop (observed: the
+#: tunnel hung mid-`large` during the round-5 battery) loses as little
+#: as possible; the parent recovers the last cumulative record line
+_REAL_EXTRAS = (("poisson", measure_poisson),
+                ("gol", measure_gol),
+                ("refined", measure_refined),
+                ("refined3", measure_refined3),
+                ("pic", measure_pic),
+                ("poisson3", measure_poisson3),
+                ("vlasov", measure_vlasov),
+                ("large", measure_large),
+                ("multidev_cpu", measure_multidev_cpu),
+                ("scalability", measure_scalability))
+
+
 def _main_real():
     tpu = measure_tpu()
     extras = {}
-    for name, fn in (("refined", measure_refined),
-                     ("refined3", measure_refined3),
-                     ("large", measure_large),
-                     ("gol", measure_gol), ("pic", measure_pic),
-                     ("poisson", measure_poisson),
-                     ("poisson3", measure_poisson3),
-                     ("vlasov", measure_vlasov),
-                     ("multidev_cpu", measure_multidev_cpu),
-                     ("scalability", measure_scalability)):
+
+    def emit(partial):
+        """Print the cumulative record line; the parent keeps the LAST
+        parseable line, so a tunnel drop hanging a later measurement
+        still leaves everything measured so far on stdout."""
+        try:
+            print(json.dumps(_build_real_record(tpu, extras, partial)),
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 - emit must never kill it
+            print(f"partial emit failed: {e}", file=sys.stderr)
+
+    emit(True)
+    for i, (name, fn) in enumerate(_REAL_EXTRAS):
         try:
             extras[name] = fn()
         except Exception as e:  # noqa: BLE001 - partial results still count
             print(f"{name} bench failed: {e}", file=sys.stderr)
             extras[name] = None
+        if i < len(_REAL_EXTRAS) - 1:  # final record is emit(False)
+            emit(True)
+    emit(False)
+
+
+def _build_real_record(tpu, extras, partial):
     try:
         cpu = measure_cpu_baseline()
     except Exception as e:  # baseline build failure must not kill the bench
@@ -1373,17 +1434,24 @@ def _main_real():
         }
     if extras.get("scalability"):
         detail["scalability"] = extras["scalability"]
-    print(
-        json.dumps(
-            {
-                "metric": "3d_advection_cell_updates_per_sec_per_chip",
-                "value": round(tpu["updates_per_s_per_chip"], 1),
-                "unit": "cell-updates/s/chip",
-                "vs_baseline": round(vs, 3),
-                "detail": detail,
-            }
-        )
-    )
+    if partial:
+        done = [n for n, _ in _REAL_EXTRAS if extras.get(n) is not None]
+        detail["partial"] = {
+            "note": "cumulative mid-bench record: a later measurement "
+                    "hung or crashed the child (tunnel drop) and the "
+                    "parent recovered this line; every number here was "
+                    "measured live on the accelerator this run",
+            "measured": ["headline"] + done,
+            "missing": [n for n, _ in _REAL_EXTRAS
+                        if extras.get(n) is None],
+        }
+    return {
+        "metric": "3d_advection_cell_updates_per_sec_per_chip",
+        "value": round(tpu["updates_per_s_per_chip"], 1),
+        "unit": "cell-updates/s/chip",
+        "vs_baseline": round(vs, 3),
+        "detail": detail,
+    }
 
 
 if __name__ == "__main__":

@@ -132,6 +132,71 @@ def test_no_progress_file_falls_back_to_24h(sandbox):
     assert compact["value"] == -1.0
 
 
+def test_partial_record_recovered_on_mid_bench_timeout(sandbox, monkeypatch):
+    """A tunnel drop mid-real-bench hangs the child until the parent's
+    timeout; the child's cumulative record lines mean the parent must
+    report the live numbers measured before the hang, not the outage
+    fallback."""
+    import subprocess
+    bench, tmp_path = sandbox
+
+    partial = json.dumps({
+        "metric": "3d_advection_cell_updates_per_sec_per_chip",
+        "value": 5.3e10, "unit": "cell-updates/s/chip",
+        "vs_baseline": 810.0,
+        "detail": {"partial": {"measured": ["headline", "poisson"],
+                               "missing": ["large"]}},
+    })
+    # the hang cut the NEXT record mid-print: the truncated line must
+    # not shadow the complete one above it
+    truncated = partial[: len(partial) // 2]
+
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(a)
+        if len(calls) == 1:  # the tunnel probe: report the chip alive
+            class R:
+                returncode = 0
+                stderr = ""
+            return R()
+        raise subprocess.TimeoutExpired(
+            cmd="bench --_real", timeout=1,
+            output=("warmup noise\n" + partial + "\n"
+                    + truncated).encode(),
+            stderr=b"tunnel hung")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    assert len(calls) == 2  # probe + real child
+    line = buf.getvalue().strip().splitlines()[-1]
+    d = json.loads(line)
+    assert d["value"] == 5.3e10 and d["vs_baseline"] == 810.0
+    # the compact line must not read as a complete battery
+    assert d["detail"]["partial_missing"] == ["large"]
+    assert d["detail"]["recovered"] is True
+    det = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())["detail"]
+    assert det["partial"]["missing"] == ["large"]
+    assert "recovery_diagnostics" in det
+
+
+def test_build_real_record_partial_flag(sandbox):
+    bench, tmp_path = sandbox
+    tpu = {"updates_per_s_per_chip": 5.2e10, "platform": "tpu",
+           "device_kind": "TPU v5 lite", "n_devices": 1, "halo_GBps": 0.0,
+           "best_updates_per_s_per_chip": 5.4e10, "times": [0.1]}
+    rec = bench._build_real_record(tpu, {}, partial=True)
+    assert rec["detail"]["partial"]["measured"] == ["headline"]
+    assert "poisson" in rec["detail"]["partial"]["missing"]
+    rec = bench._build_real_record(tpu, {}, partial=False)
+    assert "partial" not in rec["detail"]
+    assert rec["value"] == 5.2e10 and rec["vs_baseline"] > 0
+    json.dumps(rec)  # must be serializable
+
+
 def test_battery_record_guards(tmp_path, monkeypatch):
     """onchip_r3.record: a failed or host-fallback child never clobbers
     persisted on-chip evidence; the sweep map stays stamp-free so its
