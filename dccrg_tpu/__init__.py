@@ -5,6 +5,7 @@ C++/MPI library under Vlasiator) for JAX/XLA on TPU meshes: sharded SoA cell
 payloads in HBM, halo exchanges as XLA collectives over ICI, host-side
 replicated grid/AMR metadata, and native load balancing in place of Zoltan.
 """
+from . import obs
 from .core.mapping import ERROR_CELL, ERROR_INDEX, Mapping
 from .core.topology import Topology
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
@@ -22,6 +23,7 @@ __all__ = [
     "CellSpec",
     "Grid",
     "make_mesh",
+    "obs",
 ]
 
 __version__ = "0.1.0"

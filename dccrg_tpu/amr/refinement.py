@@ -181,9 +181,15 @@ def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
     hood = grid.epoch.hoods[None]
     lvl = mapping.get_refinement_level(leaves.cells)
 
+    from ..obs import metrics
+
     adj = _symmetric_adjacency(len(leaves), hood)
     override_refines(leaves, lvl, adj, queues)
+    requested_refines = len(queues.to_refine)
     induce_refines(leaves, lvl, adj, queues)
+    # refines added by the 2:1 fixed point beyond the surviving requests
+    # = balance violations the commit repaired
+    induced_refines = len(queues.to_refine) - requested_refines
     override_unrefines(mapping, grid.topology, leaves, lvl, hood.offsets, queues)
 
     refined = np.fromiter(queues.to_refine, dtype=np.uint64, count=len(queues.to_refine))
@@ -192,6 +198,12 @@ def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
         queues.to_unrefine, dtype=np.uint64, count=len(queues.to_unrefine)
     )
     unrefined.sort()
+
+    if metrics.enabled:
+        metrics.inc("amr.commits")
+        metrics.inc("amr.cells_refined", len(refined))
+        metrics.inc("amr.families_unrefined", len(unrefined))
+        metrics.inc("amr.induced_refines", induced_refines)
 
     if not len(refined) and not len(unrefined):
         # nothing survived the override passes: the leaf set is untouched,

@@ -243,15 +243,13 @@ class Grid:
     def _rebuild(self):
         """Recompute every derived structure for the current leaf set —
         the analogue of the reference's post-mutation rebuild tail
-        (``dccrg.hpp:4063-4111, 10503-10551``)."""
-        from .utils.timers import timers
-
-        with timers.phase("grid.rebuild_epoch"):
-            self.epoch = build_epoch(
-                self.mapping, self.topology, self.leaves, self.n_devices,
-                self.neighborhoods,
-                uniform_geometry=self._uniform_geometry(),
-            )
+        (``dccrg.hpp:4063-4111, 10503-10551``).  Timed as the
+        ``epoch.build`` phase inside ``build_epoch`` itself."""
+        self.epoch = build_epoch(
+            self.mapping, self.topology, self.leaves, self.n_devices,
+            self.neighborhoods,
+            uniform_geometry=self._uniform_geometry(),
+        )
         self._halo_cache = {}
         self._id_pos_cache = None
         self._unrefine_cache = None
@@ -708,22 +706,50 @@ class Grid:
         self._assert_initialized()
         if getattr(self, "_staged_lb", None) is not None:
             raise RuntimeError("a staged balance_load is in progress")
-        owner = self._compute_new_owner(use_zoltan)
-        self._prev_epoch = self.epoch
-        self._last_new_cells = np.zeros(0, dtype=np.uint64)
-        self._last_removed_cells = np.zeros(0, dtype=np.uint64)
-        # load balancing cancels pending adaptation (reference: requests
-        # are lost after balance_load, dccrg.hpp:2666-2668)
-        self.amr.clear()
-        if np.array_equal(owner, self.leaves.owner):
-            # no cell moved: every derived table is still valid, skip the
-            # (expensive) epoch rebuild; remap_state degenerates to the
-            # identity (checkpoint reload hits this on its post-replay
-            # balance when the partitioner reproduces the current owners)
-            return self
-        self.leaves = LeafSet(cells=self.leaves.cells, owner=owner)
-        self._rebuild()
+        from .obs import metrics
+
+        with metrics.phase("loadbalance.migrate"):
+            owner = self._compute_new_owner(use_zoltan)
+            self._lb_telemetry(self.leaves.owner, owner)
+            self._prev_epoch = self.epoch
+            self._last_new_cells = np.zeros(0, dtype=np.uint64)
+            self._last_removed_cells = np.zeros(0, dtype=np.uint64)
+            # load balancing cancels pending adaptation (reference:
+            # requests are lost after balance_load, dccrg.hpp:2666-2668)
+            self.amr.clear()
+            if np.array_equal(owner, self.leaves.owner):
+                # no cell moved: every derived table is still valid, skip
+                # the (expensive) epoch rebuild; remap_state degenerates
+                # to the identity (checkpoint reload hits this on its
+                # post-replay balance when the partitioner reproduces the
+                # current owners)
+                return self
+            self.leaves = LeafSet(cells=self.leaves.cells, owner=owner)
+            self._rebuild()
         return self
+
+    def _lb_telemetry(self, old_owner, new_owner):
+        """Record one repartition: cells whose owner changes and the load
+        imbalance (max device load over the mean) before/after."""
+        from .obs import metrics
+
+        if not metrics.enabled:
+            return
+        metrics.inc("loadbalance.migrations")
+        metrics.inc(
+            "loadbalance.cells_migrated",
+            int((np.asarray(old_owner) != np.asarray(new_owner)).sum()),
+        )
+
+        def imbalance(owner):
+            counts = np.bincount(
+                np.asarray(owner, dtype=np.int64), minlength=self.n_devices
+            )
+            avg = counts.mean()
+            return float(counts.max() / avg) if avg > 0 else 1.0
+
+        metrics.gauge("loadbalance.imbalance_before", imbalance(old_owner))
+        metrics.gauge("loadbalance.imbalance_after", imbalance(new_owner))
 
     def _hierarchical_partition(self, method, weights, hier, options=None):
         """Multi-level partition over a device hierarchy (reference HIER,
@@ -871,18 +897,23 @@ class Grid:
         self._assert_initialized()
         if getattr(self, "_staged_lb", None) is not None:
             raise RuntimeError("a staged balance_load is in progress")
-        owner = self._compute_new_owner(use_zoltan)
-        # load balancing cancels pending adaptation (dccrg.hpp:2666-2668)
-        self.amr.clear()
-        if np.array_equal(owner, self.leaves.owner):
-            self._staged_lb = {"noop": True}
-            return self
-        new_leaves = LeafSet(cells=self.leaves.cells, owner=owner)
-        new_epoch = build_epoch(
-            self.mapping, self.topology, new_leaves, self.n_devices,
-            self.neighborhoods,
-            uniform_geometry=self._uniform_geometry(),
-        )
+        from .obs import metrics
+
+        with metrics.phase("loadbalance.migrate"):
+            owner = self._compute_new_owner(use_zoltan)
+            self._lb_telemetry(self.leaves.owner, owner)
+            # load balancing cancels pending adaptation
+            # (dccrg.hpp:2666-2668)
+            self.amr.clear()
+            if np.array_equal(owner, self.leaves.owner):
+                self._staged_lb = {"noop": True}
+                return self
+            new_leaves = LeafSet(cells=self.leaves.cells, owner=owner)
+            new_epoch = build_epoch(
+                self.mapping, self.topology, new_leaves, self.n_devices,
+                self.neighborhoods,
+                uniform_geometry=self._uniform_geometry(),
+            )
         self._staged_lb = {
             "noop": False,
             "leaves": new_leaves,
@@ -920,6 +951,9 @@ class Grid:
         lo = st["done"]
         hi = N if max_cells is None else min(lo + int(max_cells), N)
         if lo < hi:
+            from .obs import metrics
+
+            metrics.inc("loadbalance.staged_rows", hi - lo)
             pos = np.arange(lo, hi)
             d_old, r_old = old.leaves.owner[pos], old.row_of[pos]
             d_new, r_new = new.leaves.owner[pos], new.row_of[pos]
@@ -1362,18 +1396,21 @@ class Grid:
 
         # multi-controller agreement: every process commits the union of
         # all processes' queued requests (identity under one controller)
-        if not presynced:
-            sync_adaptation(self.amr)
-        self._prev_epoch = self.epoch
-        new_cells, removed = commit_adaptation(self)
-        self._last_new_cells = new_cells
-        self._last_removed_cells = removed
-        if not len(new_cells) and not len(removed):
-            # nothing changed (nothing queued, or everything vetoed): the
-            # leaf set was left untouched, keep the current epoch and
-            # every derived table instead of paying a full rebuild
-            return new_cells.copy()
-        self._rebuild()
+        from .obs import metrics
+
+        with metrics.phase("amr.refine"):
+            if not presynced:
+                sync_adaptation(self.amr)
+            self._prev_epoch = self.epoch
+            new_cells, removed = commit_adaptation(self)
+            self._last_new_cells = new_cells
+            self._last_removed_cells = removed
+            if not len(new_cells) and not len(removed):
+                # nothing changed (nothing queued, or everything vetoed):
+                # the leaf set was left untouched, keep the current epoch
+                # and every derived table instead of paying a full rebuild
+                return new_cells.copy()
+            self._rebuild()
         return new_cells.copy()
 
     def get_removed_cells(self) -> np.ndarray:
@@ -1509,6 +1546,36 @@ class Grid:
         _vtk(self, path, scalars, binary=binary)
 
     # -------------------------------------------------------- introspection
+
+    @property
+    def telemetry(self):
+        """The process-wide metrics registry (``obs.metrics``) — the
+        statistics accessor in dccrg's getter style.  Use
+        ``grid.telemetry.report()`` for a raw snapshot, ``grid.report()``
+        for the snapshot annotated with this grid's shape."""
+        from .obs import metrics
+
+        return metrics
+
+    def report(self) -> dict:
+        """Telemetry snapshot (phases, counters, gauges, histograms from
+        every instrumented seam) plus this grid's current shape.  The
+        same structure ``obs.export_json`` writes to ``telemetry.json``."""
+        from .obs import metrics
+
+        rep = metrics.report()
+        if self.initialized:
+            rep["grid"] = {
+                "n_cells": int(len(self.leaves)),
+                "n_devices": int(self.n_devices),
+                "rows_per_device": int(self.epoch.R),
+                "ghost_cells": int(self.epoch.n_ghost.sum()),
+                "neighborhoods": len(self.neighborhoods),
+                "max_refinement_level": int(
+                    self.mapping.max_refinement_level
+                ),
+            }
+        return rep
 
     def get_number_of_update_send_cells(self, device: int, hood_id=None) -> int:
         return int(self.epoch.hoods[hood_id].pair_counts[device].sum())

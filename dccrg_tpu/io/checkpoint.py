@@ -79,7 +79,20 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
     payloads: only ``count[i]`` leading rows of the field are stored for
     cell ``i`` (reference: runtime-switched ``get_mpi_datatype``,
     ``tests/particles/cell.hpp:50-84``).
+
+    Telemetry: the whole save (collective readbacks + write) is the
+    ``checkpoint.write`` phase; ``checkpoint.bytes_written`` counts the
+    payload + cell-table bytes (identical on every controller — the
+    readbacks are collective even though only process 0 writes).
     """
+    from ..obs import metrics
+
+    with metrics.phase("checkpoint.write"):
+        _save_grid_data(grid, state, path, spec, user_header, ragged)
+
+
+def _save_grid_data(grid, state, path, spec, user_header, ragged) -> None:
+    from ..obs import metrics
     from ..utils.collectives import allgather_u64, process_count
 
     cells = grid.get_cells()
@@ -103,6 +116,9 @@ def save_grid_data(grid, state, path: str, spec, user_header: bytes = b"",
     for name, _, _, _, row_nb in ragged_fields:
         bytes_per_cell += counts[name] * row_nb
     offsets = np.concatenate(([0], np.cumsum(bytes_per_cell[:-1])))
+    metrics.inc("checkpoint.bytes_written",
+                int(bytes_per_cell.sum()) + len(cells) * 16)
+    metrics.inc("checkpoint.cells_written", len(cells))
 
     # multi-controller IO fan-in: the readbacks above are COLLECTIVE
     # (fetch all_gathers each field), so every controller runs them and
@@ -214,6 +230,14 @@ class GridLoader:
 
     def __init__(self, path: str, spec, mesh=None, n_devices=None, ragged=None,
                  load_balancing_method: str = "RCB"):
+        from ..obs import metrics
+
+        with metrics.phase("checkpoint.read"):
+            self._init_impl(path, spec, mesh, n_devices, ragged,
+                            load_balancing_method)
+
+    def _init_impl(self, path, spec, mesh, n_devices, ragged,
+                   load_balancing_method):
         from ..core.mapping import Mapping
         from ..core.topology import Topology
         from ..geometry import geometry_from_id
@@ -308,9 +332,14 @@ class GridLoader:
         offs = self._offsets
         start = int(offs[lo])
         end = int(offs[hi]) if hi < self._n_cells else self._payload_size
-        with open(self._path, "rb") as f:
-            f.seek(self._payload_start + start)
-            payload = f.read(end - start)
+        from ..obs import metrics
+
+        with metrics.phase("checkpoint.read"):
+            with open(self._path, "rb") as f:
+                f.seek(self._payload_start + start)
+                payload = f.read(end - start)
+        metrics.inc("checkpoint.bytes_read", end - start)
+        metrics.inc("checkpoint.cells_read", n)
 
         pay = np.frombuffer(payload, dtype=np.uint8)
         cursor = offs[lo:hi] - start

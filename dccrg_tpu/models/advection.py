@@ -490,7 +490,7 @@ class Advection:
         transfers, and every face flux as shifted slices that XLA fuses into
         one HBM pass — the layout the reference's per-cell object model
         cannot express but the one a TPU needs."""
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.dense import HaloExtend

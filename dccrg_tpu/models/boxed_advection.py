@@ -53,6 +53,7 @@ floating-point association order.
 from __future__ import annotations
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -529,7 +530,7 @@ def build_boxed_run(adv, layout):
         for s in statics
     ]
     data_spec = P(SHARD_AXIS)
-    sm = jax.shard_map(
+    sm = shard_map(
         body,
         mesh=mesh,
         in_specs=(data_spec, data_spec, data_spec, data_spec, P(), P(),

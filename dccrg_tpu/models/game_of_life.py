@@ -114,7 +114,7 @@ class GameOfLife:
         """Split-phase step: collective and inner compute are dataflow-
         independent inside one XLA program; outer compute depends on the
         merged ghosts.  Bit-identical results to the blocking step."""
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
@@ -201,7 +201,7 @@ class GameOfLife:
         turns (the reference's scalability configuration,
         ``tests/game_of_life/scalability.cpp``, without its per-turn
         message machinery)."""
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.dense import HaloExtend

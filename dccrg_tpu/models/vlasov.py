@@ -71,7 +71,7 @@ class Vlasov:
     # ------------------------------------------------------------- kernels
 
     def _build_step(self):
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         info = self.info

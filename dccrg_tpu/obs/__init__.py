@@ -1,0 +1,40 @@
+"""Grid-wide telemetry: metrics registry, phase spans, trace export.
+
+The reference dccrg has no tracing layer at all — timing lives ad hoc in
+its example workloads (``examples/game_of_life.cpp:116-146`` via
+``chrono``) and its method paper evaluates on end-to-end wall clock only.
+This subsystem gives the TPU port structured visibility into every hot
+seam instead:
+
+* a process-wide :class:`MetricsRegistry` (``obs.metrics``) holding
+  counters, gauges, histograms (all label-aware) and re-entrant,
+  thread-safe phase timers;
+* instrumentation wired into halo exchange (``parallel/halo.py``),
+  epoch construction (``parallel/epoch.py``), load balancing
+  (``Grid.balance_load``), AMR commits (``amr/refinement.py``) and
+  checkpoint I/O (``io/checkpoint.py``) — all recording from HOST code
+  outside jit boundaries, so jitted programs never carry per-call dict
+  churn;
+* a JSON exporter (:func:`export_json` -> ``telemetry.json``, consumed
+  by ``bench.py``) and an opt-in ``jax.profiler`` trace context
+  (:func:`profile_trace`) that annotates each instrumented phase with a
+  named ``TraceAnnotation`` span for TensorBoard/xprof.
+
+Telemetry is on by default (the recording sites are per-epoch or
+per-host-dispatch, never inside device loops); ``disable()`` — or
+``DCCRG_TELEMETRY=0`` in the environment — makes every recording call a
+cheap early return that touches no state at all.
+"""
+from .registry import MetricsRegistry, metrics, disable, enable
+from .export import export_json
+from .trace import profile_trace, trace_span
+
+__all__ = [
+    "MetricsRegistry",
+    "metrics",
+    "enable",
+    "disable",
+    "export_json",
+    "profile_trace",
+    "trace_span",
+]

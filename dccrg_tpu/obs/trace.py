@@ -1,0 +1,45 @@
+"""Opt-in ``jax.profiler`` tracing around instrumented phases.
+
+``profile_trace(log_dir)`` captures a full profiler trace (view with
+TensorBoard / xprof) and, for its duration, makes every
+``metrics.phase(...)`` span emit a named ``TraceAnnotation`` — so the
+halo/epoch/LB/AMR/checkpoint seams show up as labeled host spans
+alongside the device timeline.  This is the deep-inspection hook
+SURVEY.md §5 calls for on top of the phase timers.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .registry import metrics
+
+__all__ = ["profile_trace", "trace_span"]
+
+
+@contextmanager
+def profile_trace(log_dir: str, annotate: bool = True, registry=None):
+    """Capture a jax.profiler trace of the enclosed region.
+
+    ``annotate`` also switches the registry's phase spans to emit
+    ``TraceAnnotation`` markers while the trace runs (restored after)."""
+    import jax
+
+    reg = registry if registry is not None else metrics
+    prev = reg.annotate
+    if annotate:
+        reg.annotate = True
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        reg.annotate = prev
+
+
+@contextmanager
+def trace_span(name: str):
+    """A single named ``TraceAnnotation`` span (host timeline marker)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

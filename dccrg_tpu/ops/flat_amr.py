@@ -37,6 +37,7 @@ concat, and kernel-launch boundary of the boxed path.
 from __future__ import annotations
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 
@@ -623,7 +624,7 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
     data_spec = P(SHARD_AXIS)
     spec2 = P(SHARD_AXIS, None)
     spec4 = P(SHARD_AXIS, None, None, None)
-    sm = jax.shard_map(
+    sm = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec2, spec4, spec4, spec2, spec2,
@@ -941,7 +942,7 @@ def make_flat_ml_run(grid, tables, dtype=jnp.float32):
     data_spec = P(SHARD_AXIS)
     spec2 = P(SHARD_AXIS, None)
     spec4 = P(SHARD_AXIS, None, None, None)
-    sm = jax.shard_map(
+    sm = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec2,) + (spec4,) * 6 + (spec4,) * vl + (spec2, spec2)

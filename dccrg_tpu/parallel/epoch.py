@@ -163,7 +163,42 @@ def build_epoch(
     Vlasov) read their metric factors from ``get_level_0_cell_length``,
     which is only meaningful then — a stretched geometry must not
     qualify.
+
+    Telemetry: the whole build is the ``epoch.build`` phase (per-hood
+    neighbor searches under ``epoch.hood_build``); the resulting table
+    shapes land as ``epoch.*`` gauges.
     """
+    from ..obs import metrics
+
+    with metrics.phase("epoch.build"):
+        epoch = _build_epoch_impl(
+            mapping, topology, leaves, n_devices, neighborhoods,
+            uniform_geometry=uniform_geometry,
+        )
+    if metrics.enabled:
+        metrics.gauge("epoch.n_cells", len(epoch.leaves))
+        metrics.gauge("epoch.rows_per_device", epoch.R)
+        metrics.gauge("epoch.ghost_cells", int(epoch.n_ghost.sum()))
+        metrics.gauge("epoch.hoods", len(epoch.hoods))
+        # send/recv schedule size: cells exchanged per full halo update,
+        # summed over hoods (each pair table is symmetric by construction)
+        metrics.gauge("epoch.send_table_cells", sum(
+            int(h.pair_counts.sum()) for h in epoch.hoods.values()
+        ))
+    return epoch
+
+
+def _build_epoch_impl(
+    mapping: Mapping,
+    topology: Topology,
+    leaves: LeafSet,
+    n_devices: int,
+    neighborhoods: dict,
+    *,
+    uniform_geometry: bool,
+) -> Epoch:
+    from ..obs import metrics
+
     N = len(leaves)
     D = n_devices
     owner = leaves.owner.astype(np.int64)
@@ -172,9 +207,10 @@ def build_epoch(
     hood_raw = {}
     all_pairs = []
     for hid, offsets in neighborhoods.items():
-        lists, to_start, to_src, pairs, is_outer = _build_hood(
-            mapping, topology, leaves, offsets, D
-        )
+        with metrics.phase("epoch.hood_build"):
+            lists, to_start, to_src, pairs, is_outer = _build_hood(
+                mapping, topology, leaves, offsets, D
+            )
         hood_raw[hid] = (offsets, lists, to_start, to_src, pairs, is_outer)
         all_pairs.append(pairs)
     if all_pairs:

@@ -21,15 +21,44 @@ array values with no arithmetic.
 """
 from __future__ import annotations
 
+import time
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
+from ..obs.registry import metrics as _metrics
 from .mesh import SHARD_AXIS, put_table
 
 __all__ = ["HaloExchange", "HaloHandle"]
+
+
+def _flush_record_cache(cache: dict) -> None:
+    """Materialize a schedule's buffered dispatch counts into the
+    registry.  Shared by the registry-driven flush and the GC finalizer —
+    an epoch rebuild drops its halo schedules, and the counts they
+    buffered must land before the object goes away."""
+    for entry in cache.values():
+        pairs, n = entry
+        entry[1] = 0
+        if n:
+            _metrics.inc_batch([(key, v * n) for key, v in pairs])
+
+
+def _tracing(state) -> bool:
+    """Whether any leaf of ``state`` is an abstract tracer — i.e. the
+    exchange is being called inside someone else's jit trace, where
+    host-side telemetry would record trace-time, not run-time."""
+    try:
+        tracer = jax.core.Tracer
+    except AttributeError:  # jax moved/removed the alias
+        return False
+    return any(
+        isinstance(x, tracer) for x in jax.tree_util.tree_leaves(state)
+    )
 
 
 class HaloHandle:
@@ -93,7 +122,23 @@ class HaloExchange:
         self._field_rings: dict = {}
         self._selective_fns: dict = {}
         (self.ring_ks, self.ring_perms, self.ring_send, self.ring_recv,
-         self.wire_cells, _cells) = self._ring_from_pairs(pair_lists)
+         self.wire_cells, _cells,
+         self.ring_sizes) = self._ring_from_pairs(pair_lists)
+        #: per-device cells shipped/received each exchange (telemetry;
+        #: pairwise-symmetric by construction, so send and recv totals
+        #: agree on every controller).  Static per schedule, so they are
+        #: recorded ONCE here as gauges instead of per dispatch.
+        self._send_per_dev = hood.pair_counts.sum(axis=1)
+        self._recv_per_dev = hood.pair_counts.sum(axis=0)
+        if _metrics.enabled:
+            hood_label = "default" if hood_id is None else str(hood_id)
+            for d in range(D):
+                _metrics.gauge("halo.send_cells_per_exchange",
+                               int(self._send_per_dev[d]),
+                               device=d, hood=hood_label)
+                _metrics.gauge("halo.recv_cells_per_exchange",
+                               int(self._recv_per_dev[d]),
+                               device=d, hood=hood_label)
         self._fn = self._build()
 
     def _ring_from_pairs(self, pair_lists):
@@ -105,7 +150,7 @@ class HaloExchange:
         under many (jit closes over them transitively; closing over
         another process's device array is rejected)."""
         D, scratch = self.D, self.R - 1
-        ks, perms, send_dev, recv_dev = [], [], [], []
+        ks, perms, send_dev, recv_dev, sizes = [], [], [], [], []
         wire = 0
         cells = 0
         for k in range(1, D):
@@ -130,8 +175,9 @@ class HaloExchange:
             perms.append([(d, (d + k) % D) for d in range(D)])
             send_dev.append(put_table(st, self.mesh))
             recv_dev.append(put_table(rt, self.mesh))
+            sizes.append(S_k)
             wire += D * S_k
-        return ks, perms, send_dev, recv_dev, wire, cells
+        return ks, perms, send_dev, recv_dev, wire, cells, sizes
 
     def _rings_for_field(self, name: str):
         """The (ks, perms, send, recv) schedule moving ``name``: the
@@ -155,7 +201,7 @@ class HaloExchange:
                 if mask.any():
                     filtered[(i, j)] = (np.asarray(sr)[mask],
                                         np.asarray(rr)[mask])
-            ks, perms, send, recv, wire, cells = (
+            ks, perms, send, recv, wire, cells, _sizes = (
                 self._ring_from_pairs(filtered)
             )
             self._field_rings[name] = (ks, perms, send, recv, wire, cells)
@@ -305,12 +351,124 @@ class HaloExchange:
                 "got a HaloHandle where a state pytree belongs — pass the "
                 "handle as wait_remote_neighbor_copy_updates(state, handle)"
             )
+        if _metrics.enabled and not _tracing(state):
+            self._record(state, "blocking")
+            t0 = time.perf_counter()
+            out = self._dispatch(state)
+            _metrics.phase_add("halo.exchange", time.perf_counter() - t0)
+            return out
+        return self._dispatch(state)
+
+    def _dispatch(self, state):
         if self._cell_datatype is None:
             return self._fn(*self.ring_send, *self.ring_recv, state)
         names = self._names(state)
         block, _start, _finish, tab_args = self._selective(names)
         outs = block(*tab_args, *(state[n] for n in names))
         return {**state, **dict(zip(names, outs))}
+
+    # ------------------------------------------------------- telemetry
+
+    def _record(self, state, kind: str) -> None:
+        """Host-side telemetry for one exchange dispatch: message/byte
+        accounting per ring distance and field.  Callers gate on
+        ``metrics.enabled and not _tracing(state)`` — recording inside a
+        jit trace would count trace-time, not run-time, so exchanges
+        embedded in fused device loops are intentionally not counted
+        per step (the jitted program carries no telemetry ops at all).
+        The phase timer around the dispatch measures host dispatch wall
+        time; the collectives themselves complete asynchronously.
+
+        Every recorded value is a pure function of the schedule and the
+        state's field signature (shapes/dtypes), so the prepared batch is
+        cached per signature and a dispatch only bumps its multiplicity —
+        the batch materializes into the registry when a report/export
+        flushes it (``metrics.register_flusher``).  A repeat dispatch
+        therefore costs a signature hash and one integer add (the
+        ≤2%-overhead budget of the bench acceptance).  The bare ``+= 1``
+        is not atomic across threads; a lost bump under thread races is
+        accepted — this is telemetry, not accounting."""
+        if isinstance(state, dict):
+            sig = (kind,) + tuple(
+                (n, x.shape, x.dtype) for n, x in state.items()
+            )
+        else:
+            sig = (kind, "tree") + tuple(
+                (x.shape, x.dtype)
+                for x in jax.tree_util.tree_leaves(state)
+            )
+        cache = getattr(self, "_record_cache", None)
+        if cache is None:
+            cache = self._record_cache = {}
+            _metrics.register_flusher(self)
+            # epoch rebuilds drop their schedules (grid._halo_cache is
+            # cleared); pending buffered counts must not die with them
+            weakref.finalize(self, _flush_record_cache, cache)
+        entry = cache.get(sig)
+        if entry is None:
+            from ..obs.registry import _labels_key
+
+            hood = "default" if self.hood_id is None else str(self.hood_id)
+            items = [
+                ("halo.exchanges", 1, {"kind": kind, "hood": hood}),
+                ("halo.cells_moved", self.cells_moved),
+                ("halo.bytes_moved", self.bytes_moved(state)),
+                ("halo.wire_bytes", self.wire_bytes(state)),
+                ("halo.permute_steps", len(self.ring_ks)),
+            ]
+            # per-device cells per dispatch (schedule rows; for a
+            # cell_datatype policy this counts the full-payload schedule,
+            # field-accurate bytes are in halo.field_bytes)
+            items.extend(
+                ("halo.send_cells", int(self._send_per_dev[d]),
+                 {"device": d, "hood": hood}) for d in range(self.D)
+            )
+            items.extend(
+                ("halo.recv_cells", int(self._recv_per_dev[d]),
+                 {"device": d, "hood": hood}) for d in range(self.D)
+            )
+            per = self._per_cell_bytes(state)
+            if self._cell_datatype is None:
+                items.extend(
+                    ("halo.ring_bytes", self.D * S * per, {"ring": k})
+                    for k, S in zip(self.ring_ks, self.ring_sizes)
+                )
+                if isinstance(state, dict):
+                    items.extend(
+                        ("halo.field_bytes",
+                         self.cells_moved * self._per_cell_bytes({n: arr}),
+                         {"field": n})
+                        for n, arr in state.items()
+                    )
+            else:
+                for n in self._names(state):
+                    self._rings_for_field(n)
+                    _ks, _p, _s, _r, f_wire, f_cells = self._field_rings[n]
+                    items.append(
+                        ("halo.field_bytes",
+                         f_cells * self._per_cell_bytes({n: state[n]}),
+                         {"field": n})
+                    )
+            entry = cache[sig] = [
+                [
+                    ((it[0], _labels_key(it[2]) if len(it) > 2 else ()),
+                     int(it[1])) for it in items
+                ],
+                0,
+            ]
+        entry[1] += 1
+
+    def telemetry_flush(self, discard: bool = False) -> None:
+        """Materialize buffered dispatch counts into the registry (or
+        drop them on ``discard`` — a registry reset)."""
+        cache = getattr(self, "_record_cache", None)
+        if not cache:
+            return
+        if discard:
+            for entry in cache.values():
+                entry[1] = 0
+            return
+        _flush_record_cache(cache)
 
     # ------------------------------------------------------- split-phase
 
@@ -380,6 +538,8 @@ class HaloExchange:
         pytree."""
         if isinstance(state, HaloHandle):
             raise TypeError("start() takes the state, not a HaloHandle")
+        if _metrics.enabled and not _tracing(state):
+            self._record(state, "split")
         if self._cell_datatype is not None:
             names = self._names(state)
             _block, start, _finish, tab_args = self._selective(names)
@@ -395,6 +555,14 @@ class HaloExchange:
             raise TypeError(
                 "finish() expects the HaloHandle returned by start()"
             )
+        if _metrics.enabled and not _tracing(state):
+            t0 = time.perf_counter()
+            out = self._finish_dispatch(state, handle)
+            _metrics.phase_add("halo.exchange", time.perf_counter() - t0)
+            return out
+        return self._finish_dispatch(state, handle)
+
+    def _finish_dispatch(self, state, handle: HaloHandle):
         if self._cell_datatype is not None:
             names, payload = handle.payload
             if names != self._names(state):

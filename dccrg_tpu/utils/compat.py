@@ -1,0 +1,35 @@
+"""Version-compat seam for the jax surface this package touches.
+
+The package targets the current ``jax.shard_map`` API (``check_vma``
+keyword, top-level export).  Older jaxlibs that the deployment image may
+pin ship the same machinery as ``jax.experimental.shard_map.shard_map``
+with the ``check_rep`` spelling — one import seam keeps every call site
+on the new vocabulary instead of scattering try/excepts through the
+kernels.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, check_vma keyword
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    elif _CHECK_KW == "check_rep":
+        # old jax's replication checker has no rule for while/fori loops
+        # (it aborts whole-run kernels); it is a checker only, results
+        # are unaffected, so default it off there.  New jax keeps its
+        # own default when the caller does not specify.
+        kwargs[_CHECK_KW] = False
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -1,67 +1,74 @@
-"""Lightweight per-phase timers.
+"""Back-compat phase timers — a shim over the ``obs`` metrics registry.
 
-The reference has no tracing layer (timing lives in its workloads via
-``chrono``, e.g. examples/game_of_life.cpp:116-146); SURVEY.md flags this
-as a gap to fill.  This registry times named phases (grid rebuilds, halo
-exchanges, solver iterations) with negligible overhead and can hand its
-spans to ``jax.profiler`` traces when deeper inspection is needed.
+The original 67-line ``PhaseTimers`` grew into ``dccrg_tpu.obs``
+(structured counters/gauges/histograms + thread-safe, re-entrant phase
+spans); this module keeps the old surface alive:
+
+* ``timers`` — the process-wide default, now a view over ``obs.metrics``
+  so phases recorded by the instrumented seams (``epoch.build``,
+  ``halo.exchange``, ...) appear in ``timers.report()`` unchanged;
+* ``PhaseTimers()`` — an isolated registry with the old API
+  (``phase``/``report``/``reset``/``total``/``count``/``enabled``).
+
+The old implementation double-counted a ``phase("x")`` nested inside
+``phase("x")`` (both spans added their wall time); the obs registry
+counts only the outermost span per thread, and is lock-protected.
 """
 from __future__ import annotations
 
-import time
-from collections import defaultdict
 from contextlib import contextmanager
+
+from ..obs.registry import MetricsRegistry
+from ..obs.registry import metrics as _global_metrics
 
 __all__ = ["PhaseTimers", "timers"]
 
 
 class PhaseTimers:
-    def __init__(self):
-        self.total = defaultdict(float)
-        self.count = defaultdict(int)
-        self.enabled = True
+    """The pre-obs timer API, delegating to a :class:`MetricsRegistry`."""
 
-    @contextmanager
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self._registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self._registry.enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._registry.enabled = bool(value)
+
     def phase(self, name: str):
-        if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.total[name] += dt
-            self.count[name] += 1
+        return self._registry.phase(name)
 
     def report(self) -> dict:
-        return {
-            name: {
-                "total_s": round(self.total[name], 6),
-                "count": self.count[name],
-                "mean_s": round(self.total[name] / max(self.count[name], 1), 6),
-            }
-            for name in sorted(self.total)
-        }
+        return self._registry.report()["phases"]
 
     def reset(self):
-        self.total.clear()
-        self.count.clear()
+        self._registry.reset()
+
+    # legacy raw accessors: {name: seconds} / {name: completions}
+    @property
+    def total(self) -> dict:
+        return {n: rec["total_s"] for n, rec in self.report().items()}
+
+    @property
+    def count(self) -> dict:
+        return {n: rec["count"] for n, rec in self.report().items()}
 
 
-#: process-wide default registry
-timers = PhaseTimers()
+#: process-wide default registry (a view over ``obs.metrics``)
+timers = PhaseTimers(registry=_global_metrics)
 
 
 @contextmanager
 def jax_trace(log_dir: str):
     """Capture a jax.profiler trace around a region (view with
-    TensorBoard / xprof) — the deep-inspection hook SURVEY.md §5 calls for
-    on top of the phase timers."""
-    import jax
+    TensorBoard / xprof) — kept for back-compat; ``obs.profile_trace``
+    is the full form (adds per-phase TraceAnnotation spans)."""
+    from ..obs.trace import profile_trace
 
-    jax.profiler.start_trace(log_dir)
-    try:
+    with profile_trace(log_dir, annotate=True):
         yield
-    finally:
-        jax.profiler.stop_trace()

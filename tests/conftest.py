@@ -19,6 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS fallback above is the only control; it was
+    # set before any backend initialized, so the 8-device mesh still forms
+    pass
 # the reference is double-precision throughout; tests assert in f64
 jax.config.update("jax_enable_x64", True)

@@ -109,6 +109,40 @@ def main() -> None:
     verify_user_data(g2, st3, spec)
     res["ghost"] = "ok"
 
+    # ---- scenario 3b: per-device halo telemetry ----------------------
+    # One explicit exchange; the obs counters' deltas must match the
+    # epoch's pair tables on EVERY controller (the replicated-schedule
+    # invariant), total send == total recv (every shipped cell lands),
+    # and the recorded numbers go into the RESULT dict so the driver's
+    # cross-rank equality check proves the telemetry itself is
+    # symmetric across ranks — not just the final field values.
+    from dccrg_tpu import obs
+
+    D2 = g2.n_devices
+
+    def dev_counters(name):
+        return [
+            int(obs.metrics.counter_value(name, device=d, hood="default"))
+            for d in range(D2)
+        ]
+
+    send0, recv0 = dev_counters("halo.send_cells"), dev_counters("halo.recv_cells")
+    bytes0 = int(obs.metrics.counter_value("halo.bytes_moved"))
+    st3 = g2.update_copies_of_remote_neighbors(st3)
+    dsend = [a - b for a, b in zip(dev_counters("halo.send_cells"), send0)]
+    drecv = [a - b for a, b in zip(dev_counters("halo.recv_cells"), recv0)]
+    dbytes = int(obs.metrics.counter_value("halo.bytes_moved")) - bytes0
+    pair_counts = g2.epoch.hoods[None].pair_counts
+    assert dsend == [int(v) for v in pair_counts.sum(axis=1)], dsend
+    assert drecv == [int(v) for v in pair_counts.sum(axis=0)], drecv
+    assert sum(dsend) == sum(drecv)
+    assert dbytes == sum(dsend) * 8  # one f64 per cell
+    res["telemetry"] = {
+        "halo_send_cells": dsend,
+        "halo_recv_cells": drecv,
+        "halo_bytes_moved": dbytes,
+    }
+
     # ---- scenario 4: balance_load with per-controller pins -----------
     # controller 0 pins the first leaf to the last device; every other
     # controller pins the last leaf to device 0 (identical duplicates —
