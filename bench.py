@@ -981,14 +981,29 @@ def _write_telemetry() -> None:
     with refinement, load balance, halo exchanges and a checkpoint
     round) on the CPU backend in a child process.  The probe guarantees
     every instrumented phase appears with nonzero counts even when the
-    accelerator tunnel is down; its failure must never block the bench."""
+    accelerator tunnel is down; its failure must never block the bench.
+
+    The PREVIOUS round's probe is archived to
+    ``tools/telemetry_prev.json`` first, then the regression gate
+    (``tools/telemetry_diff.py``) compares the fresh round against it —
+    the verdict lands in ``tools/telemetry_diff.json`` and is folded
+    into the bench record by ``_attach_telemetry``.  The gate is
+    informational here (the bench must always emit its line); CI runs
+    the tool standalone for a hard pass/fail."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    tpath = ROOT / "telemetry.json"
+    prev = ROOT / "tools" / "telemetry_prev.json"
+    try:
+        if tpath.exists():
+            prev.write_text(tpath.read_text())
+    except OSError as e:
+        print(f"could not archive previous telemetry: {e}", file=sys.stderr)
     try:
         r = subprocess.run(
             [sys.executable, str(ROOT / "tools" / "check_telemetry.py"),
-             "--out", str(ROOT / "telemetry.json"), "--skip-overhead"],
+             "--out", str(tpath), "--skip-overhead"],
             env=env, capture_output=True, text=True, timeout=900,
         )
         if r.returncode != 0:
@@ -996,6 +1011,19 @@ def _write_telemetry() -> None:
                   file=sys.stderr)
     except Exception as e:  # noqa: BLE001 - telemetry never kills the bench
         print(f"telemetry probe failed: {e}", file=sys.stderr)
+    if not (tpath.exists() and prev.exists()):
+        return
+    try:
+        r = subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "telemetry_diff.py"),
+             "--current", str(tpath), "--baseline", str(prev),
+             "--json", str(ROOT / "tools" / "telemetry_diff.json")],
+            capture_output=True, text=True, timeout=120,
+        )
+        tail = (r.stdout.strip().splitlines() or [""])[-1]
+        print(f"telemetry regression gate: {tail}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"telemetry diff failed: {e}", file=sys.stderr)
 
 
 def _attach_telemetry(record: dict) -> None:
@@ -1018,6 +1046,21 @@ def _attach_telemetry(record: dict) -> None:
         }
     except (OSError, ValueError) as e:
         print(f"could not attach telemetry.json: {e}", file=sys.stderr)
+    # round-over-round regression gate verdict (tools/telemetry_diff.py,
+    # run by _write_telemetry) — informational in the record; CI uses
+    # the tool's exit code directly
+    vpath = ROOT / "tools" / "telemetry_diff.json"
+    if vpath.exists():
+        try:
+            v = json.loads(vpath.read_text())
+            record["detail"]["telemetry"]["regression_gate"] = {
+                "verdict": v.get("verdict"),
+                "threshold": v.get("threshold"),
+                "failures": v.get("failures", []),
+                "baseline": v.get("baseline"),
+            }
+        except (OSError, ValueError, KeyError) as e:
+            print(f"could not attach diff verdict: {e}", file=sys.stderr)
 
 
 def _emit(record: dict):
@@ -1364,7 +1407,33 @@ _REAL_EXTRAS = (("poisson", measure_poisson),
 
 
 def _main_real():
+    # streaming telemetry: periodic ticker + a forced snapshot at every
+    # measurement boundary, so a tunnel drop mid-battery leaves the
+    # per-phase evidence of everything that ran (telemetry_stream.jsonl,
+    # schema-gated by tools/check_telemetry.py --validate-stream)
+    stream = None
+    try:
+        from dccrg_tpu import obs
+
+        stream = obs.stream_to(
+            str(ROOT / "telemetry_stream.jsonl"), period=60.0,
+            truncate=True, extra={"source": "bench"},
+        )
+    except Exception as e:  # noqa: BLE001 - telemetry never kills the bench
+        print(f"bench stream unavailable: {e}", file=sys.stderr)
+
+    def checkpoint(name):
+        """Bench checkpoint: per-device HBM gauges + one stream line."""
+        if stream is None:
+            return
+        try:
+            obs.sample_hbm()
+            stream.write_snapshot(measurement=name)
+        except Exception:  # noqa: BLE001
+            pass
+
     tpu = measure_tpu()
+    checkpoint("headline")
     extras = {}
 
     def emit(partial):
@@ -1384,9 +1453,16 @@ def _main_real():
         except Exception as e:  # noqa: BLE001 - partial results still count
             print(f"{name} bench failed: {e}", file=sys.stderr)
             extras[name] = None
+        checkpoint(name)
         if i < len(_REAL_EXTRAS) - 1:  # final record is emit(False)
             emit(True)
     emit(False)
+    if stream is not None:
+        try:
+            obs.export_chrome_trace(str(ROOT / "trace_events.json"))
+            stream.stop(final=True)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def _build_real_record(tpu, extras, partial):

@@ -1557,13 +1557,24 @@ class Grid:
 
         return metrics
 
+    @property
+    def events(self):
+        """The process-wide event timeline (``obs.timeline``): the
+        individual begin/end spans behind the aggregate phase timers.
+        Export with ``obs.export_chrome_trace(path)`` for perfetto."""
+        from .obs import timeline
+
+        return timeline
+
     def report(self) -> dict:
         """Telemetry snapshot (phases, counters, gauges, histograms from
-        every instrumented seam) plus this grid's current shape.  The
-        same structure ``obs.export_json`` writes to ``telemetry.json``."""
-        from .obs import metrics
+        every instrumented seam) plus this grid's current shape and the
+        event-timeline fill state.  The same structure
+        ``obs.export_json`` writes to ``telemetry.json``."""
+        from .obs import metrics, timeline
 
         rep = metrics.report()
+        rep["events"] = timeline.summary()
         if self.initialized:
             rep["grid"] = {
                 "n_cells": int(len(self.leaves)),

@@ -866,6 +866,22 @@ class Advection:
     def step(self, state, dt):
         return self._step(state, dt)
 
+    def _record_run(self, path: str, steps, state) -> None:
+        """Post-run reconciliation (obs.fused): the whole-run paths keep
+        their ghost traffic inside jit, so the host seam sees nothing —
+        record ``steps x schedule bytes`` once per dispatch instead."""
+        from ..obs import fused
+
+        if not self.grid.telemetry.enabled:
+            return
+        try:
+            bps = self.grid.halo(None).bytes_moved(
+                {"density": state["density"]}
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            bps = 0
+        fused.record_run("advection", path, steps, bps)
+
     def run(self, state, steps: int, dt):
         """Advance ``steps`` timesteps in a single device-side loop
         (``lax.fori_loop``) — one dispatch for the whole run, the
@@ -873,6 +889,7 @@ class Advection:
         (2d.cpp:321+).  Use this for tight stepping; ``step`` for loops
         interleaved with host logic (AMR, load balancing, IO)."""
         if getattr(self, "_fused_run", None) is not None:
+            self._record_run("fused", steps, state)
             return self._fused_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
@@ -880,6 +897,7 @@ class Advection:
             getattr(self, "_prefer_boxed", False)
             and getattr(self, "_boxed_run", None) is not None
         ):
+            self._record_run("boxed", steps, state)
             return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
@@ -889,6 +907,7 @@ class Advection:
             # the boxed/general dispatch permanently for this instance —
             # but only after the fallback succeeds on the same inputs
             # (utils/fallback.py's policy), so a caller error propagates
+            self._record_run("flat", steps, state)
             return fallback_call(
                 "flat AMR kernel",
                 lambda: self._flat_run(
@@ -907,10 +926,12 @@ class Advection:
         """The non-flat whole-run dispatch: boxed, dense, or the general
         gather-path fori_loop."""
         if getattr(self, "_boxed_run", None) is not None:
+            self._record_run("boxed", steps, state)
             return self._boxed_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if getattr(self, "_dense_run", None) is not None:
+            self._record_run("dense", steps, state)
             return self._dense_run(
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
@@ -922,6 +943,7 @@ class Advection:
                 return jax.lax.fori_loop(0, steps, lambda i, st: inner(st, dt), state)
 
             self._run = run_fn
+        self._record_run("general", steps, state)
         return self._run(state, steps, jnp.asarray(dt, self.dtype))
 
     def max_time_step(self, state) -> float:

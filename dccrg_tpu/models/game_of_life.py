@@ -335,17 +335,36 @@ class GameOfLife:
         watchdog on oversubscribed hosts (virtual-device meshes), and a
         depth-16 pipeline already hides dispatch latency on real chips."""
         if self._fused_run is not None and turns > 0:
+            self._record_run("fused", turns, state)
             return fallback_call(
                 "fused GoL kernel", self._fused_run, self._dense_run,
                 self._disable_fused, state, jnp.asarray(turns, jnp.int32),
             )
         if self._dense_run is not None and turns > 0:
+            self._record_run("dense", turns, state)
             return self._dense_run(state, jnp.asarray(turns, jnp.int32))
         for i in range(turns):
             state = self._step(state)
             if sync_every and (i + 1) % sync_every == 0:
                 jax.block_until_ready(state)
         return state
+
+    def _record_run(self, path: str, turns, state) -> None:
+        """Whole-run dispatches keep their ghost traffic inside jit —
+        reconcile ``turns x schedule bytes`` on the host (obs.fused).
+        Only ``is_alive`` crosses the wire, like the reference's
+        ``get_mpi_datatype`` (examples/simple_game_of_life.cpp:20-32)."""
+        from ..obs import fused
+
+        if not self.grid.telemetry.enabled:
+            return
+        try:
+            bps = self._exchange.bytes_moved(
+                {"is_alive": state["is_alive"]}
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            bps = 0
+        fused.record_run("game_of_life", path, turns, bps)
 
     def alive_cells(self, state) -> np.ndarray:
         cells = self.grid.get_cells()

@@ -338,12 +338,39 @@ class Vlasov:
             )
         return self._step(state, dt)
 
+    def _record_run(self, path: str, steps, state) -> None:
+        """Post-run reconciliation (obs.fused): the device-loop runs keep
+        their ghost traffic inside jit.  Dense layout: each step's slab
+        ring ships two [ny, nx, B] planes per device (none on a single
+        device, where the wrap is local); general layout: the full-f
+        halo schedule."""
+        from ..obs import fused
+
+        if not self.grid.telemetry.enabled:
+            return
+        try:
+            if self.info is not None:
+                D = self.grid.n_devices
+                itemsize = np.dtype(self.dtype).itemsize
+                bps = (
+                    D * 2 * self.info.ny * self.info.nx * self.B * itemsize
+                    if D > 1 else 0
+                )
+            else:
+                bps = self.grid.halo(None).bytes_moved({"f": state["f"]})
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            bps = 0
+        fused.record_run("vlasov", path, steps, bps)
+
     def run(self, state, steps: int, dt):
         if self._fused_block:
+            self._record_run("fused", steps, state)
             return fallback_call(
                 "fused Vlasov kernel", self._run, self._run_xla,
                 self._disable_fused, state, steps, dt,
             )
+        self._record_run("xla" if self.info is not None else "general",
+                         steps, state)
         return self._run(state, steps, dt)
 
     def max_time_step(self) -> float:

@@ -18,16 +18,38 @@ seam instead:
 * a JSON exporter (:func:`export_json` -> ``telemetry.json``, consumed
   by ``bench.py``) and an opt-in ``jax.profiler`` trace context
   (:func:`profile_trace`) that annotates each instrumented phase with a
-  named ``TraceAnnotation`` span for TensorBoard/xprof.
+  named ``TraceAnnotation`` span for TensorBoard/xprof;
+* a streaming exporter (:func:`stream_to`) appending incremental JSONL
+  snapshots on a period, so a hung or killed run leaves phase evidence
+  behind (``tools/soak.py``, ``bench.py``, the on-chip battery);
+* a structured event timeline (``obs.timeline``) recording every
+  completed phase as a begin/end span, exportable as Chrome trace-event
+  JSON (:func:`export_chrome_trace`, view in perfetto);
+* per-device memory gauges (:func:`sample_hbm` ->
+  ``hbm.bytes_in_use{device=d}``), sampled at epoch rebuilds and bench
+  checkpoints, and post-run reconciliation counters for the fused
+  whole-run kernels that bypass the host halo seam (``obs.fused``).
 
 Telemetry is on by default (the recording sites are per-epoch or
 per-host-dispatch, never inside device loops); ``disable()`` — or
 ``DCCRG_TELEMETRY=0`` in the environment — makes every recording call a
-cheap early return that touches no state at all.
+cheap early return that touches no state at all.  The event timeline
+can be switched off independently (``DCCRG_TIMELINE=0``).
 """
 from .registry import MetricsRegistry, metrics, disable, enable
 from .export import export_json
 from .trace import profile_trace, trace_span
+from .stream import TelemetryStream, stream_to
+from .events import (
+    EventTimeline,
+    timeline,
+    span,
+    export_chrome_trace,
+    enable_timeline,
+    disable_timeline,
+)
+from .hbm import sample_hbm
+from . import fused
 
 __all__ = [
     "MetricsRegistry",
@@ -37,4 +59,14 @@ __all__ = [
     "export_json",
     "profile_trace",
     "trace_span",
+    "TelemetryStream",
+    "stream_to",
+    "EventTimeline",
+    "timeline",
+    "span",
+    "export_chrome_trace",
+    "enable_timeline",
+    "disable_timeline",
+    "sample_hbm",
+    "fused",
 ]

@@ -1,0 +1,59 @@
+"""Per-device memory gauges from ``Device.memory_stats()``.
+
+The bench's OOM margins were invisible per round: a grid that barely
+fits HBM today silently stops fitting after a refinement change.
+``sample_hbm`` snapshots each local device's allocator statistics into
+``hbm.*{device=d}`` gauges — called at every epoch rebuild
+(``parallel/epoch.py``, the moment payload arrays are re-laid-out) and
+at bench checkpoints (``bench.py`` after each measurement).
+
+Backends without allocator stats (CPU returns ``None``; some plugins
+raise) record nothing — the gauges simply stay absent there.
+"""
+from __future__ import annotations
+
+from .registry import metrics
+
+__all__ = ["sample_hbm"]
+
+#: the allocator stats worth tracking round-over-round (when present)
+_STAT_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "largest_free_block_bytes",
+)
+
+
+def sample_hbm(registry=None, devices=None) -> dict:
+    """Record ``hbm.<stat>{device=d}`` gauges for every local device
+    that reports memory statistics; returns ``{device_id: {stat: v}}``
+    for whatever was sampled (empty on statless backends)."""
+    reg = registry if registry is not None else metrics
+    if not reg.enabled:
+        return {}
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 — no backend, no gauges
+            return {}
+    out: dict = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — plugin without the API
+            stats = None
+        if not stats:
+            continue
+        dev_id = int(getattr(d, "id", 0))
+        rec = {}
+        for key in _STAT_KEYS:
+            v = stats.get(key)
+            if isinstance(v, (int, float)):
+                reg.gauge(f"hbm.{key}", int(v), device=dev_id)
+                rec[key] = int(v)
+        if rec:
+            out[dev_id] = rec
+    return out

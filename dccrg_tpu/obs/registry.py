@@ -72,6 +72,10 @@ class MetricsRegistry:
         self._tls = threading.local()
         #: deferred recorders (see :meth:`register_flusher`)
         self._flushers = weakref.WeakSet()
+        #: optional event timeline fed every completed phase span
+        #: (attached by ``obs.events`` for the process-wide registry;
+        #: stays None for isolated test registries unless set)
+        self.timeline = None
 
     # ------------------------------------------------------------- writes
 
@@ -178,6 +182,9 @@ class MetricsRegistry:
             else:
                 rec[0] += dt
                 rec[1] += 1
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            tl.add(name, time.perf_counter() - dt, dt)
 
     @contextmanager
     def phase(self, name: str):
@@ -218,6 +225,9 @@ class MetricsRegistry:
                     else:
                         rec[0] += dt
                         rec[1] += 1
+                tl = self.timeline
+                if tl is not None and tl.enabled:
+                    tl.add(name, t0, dt)
             else:
                 depths[name] = outer
 

@@ -185,6 +185,11 @@ def build_epoch(
         metrics.gauge("epoch.send_table_cells", sum(
             int(h.pair_counts.sum()) for h in epoch.hoods.values()
         ))
+        # per-device allocator state right after the re-layout — the
+        # moment OOM margins change (no-op on statless backends)
+        from ..obs import sample_hbm
+
+        sample_hbm(metrics)
     return epoch
 
 

@@ -35,6 +35,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+#: worker-output signatures of a jaxlib whose CPU backend cannot run
+#: cross-process collectives at all — an environment gap, not a bug in
+#: this package, so the suite SKIPS with the reason instead of erroring
+#: (ROADMAP jax version pin item; jaxlib 0.4.x raises the first one)
+_NO_MULTIPROC_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "multi-process computations are not supported",
+    "cross-host collectives are not implemented",
+)
+
+
+def _skip_if_backend_lacks_collectives(worker_output: str) -> None:
+    for marker in _NO_MULTIPROC_MARKERS:
+        if marker in worker_output:
+            pytest.skip(
+                "this jaxlib's CPU backend lacks multiprocess "
+                f"collectives ({marker!r}); pin the image's jax forward "
+                "to run the multi-controller suite"
+            )
+
+
 def _run_workers(nproc: int, dpp: int = 4, timeout: float = 420.0):
     port = _free_port()
     procs, logs = [], []
@@ -60,6 +81,8 @@ def _run_workers(nproc: int, dpp: int = 4, timeout: float = 420.0):
         for p in procs:
             out, _ = p.communicate(timeout=timeout)
             logs.append(out)
+            if p.returncode != 0:
+                _skip_if_backend_lacks_collectives(out)
             assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
             lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
             assert lines, f"no RESULT line:\n{out[-4000:]}"

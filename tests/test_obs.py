@@ -1,7 +1,12 @@
 """Telemetry subsystem tests: registry semantics, zero-overhead disabled
 mode, nested/re-entrant phases, JSON export round-trip, the timers
 back-compat shim, instrumented-seam coverage, and a ``Grid.report()``
-smoke test on a refined game-of-life run (ISSUE 1 satellite)."""
+smoke test on a refined game-of-life run (ISSUE 1 satellite).
+
+ISSUE 2 layers: the streaming JSONL exporter, the begin/end event
+timeline + Chrome trace export, per-device HBM gauges, fused-kernel
+reconciliation counters, and the ``obs.profile_trace`` materialization
+gate (previously only exercised manually via TensorBoard/xprof)."""
 import json
 import os
 import sys
@@ -343,6 +348,346 @@ def test_halo_counters_survive_schedule_retirement():
     g.stop_refining()
     gc.collect()
     assert obs.metrics.counter_value("halo.cells_moved") == moved
+
+
+# ------------------------------------------------------- event timeline
+
+
+def test_timeline_records_registry_phases():
+    from dccrg_tpu.obs.events import EventTimeline
+
+    reg = MetricsRegistry()
+    tl = EventTimeline(enabled=True)
+    reg.timeline = tl
+    with reg.phase("outer"):
+        with reg.phase("inner"):
+            time.sleep(0.005)
+    reg.phase_add("halo.exchange", 0.002)
+    assert len(tl) == 3
+    names = {e["name"] for e in tl.chrome_trace()["traceEvents"]}
+    assert names == {"outer", "inner", "halo.exchange"}
+    # a disabled registry records nothing into the timeline either
+    reg.enabled = False
+    with reg.phase("off"):
+        pass
+    reg.phase_add("off2", 0.001)
+    assert len(tl) == 3
+
+
+def test_timeline_chrome_trace_pairs_and_nesting():
+    from dccrg_tpu.obs.events import EventTimeline
+
+    tl = EventTimeline(enabled=True)
+    with tl.span("outer", kind="test"):
+        with tl.span("inner"):
+            time.sleep(0.002)
+    trace = tl.chrome_trace()
+    evs = trace["traceEvents"]
+    # matched B/E pairs in stack order: B outer, B inner, E inner, E outer
+    assert [(e["ph"], e["name"]) for e in evs] == [
+        ("B", "outer"), ("B", "inner"), ("E", "inner"), ("E", "outer"),
+    ]
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts) and ts[0] >= 0
+    assert evs[0]["args"] == {"kind": "test"}
+    assert trace["otherData"]["dropped_events"] == 0
+
+
+def test_timeline_bounded_and_disabled():
+    from dccrg_tpu.obs.events import EventTimeline
+
+    tl = EventTimeline(enabled=True, max_events=3)
+    for i in range(5):
+        tl.add(f"e{i}", float(i), 0.5)
+    assert len(tl) == 3
+    assert tl.summary()["dropped"] == 2
+    tl.clear()
+    assert len(tl) == 0 and tl.summary()["dropped"] == 0
+    tl.enabled = False
+    with tl.span("nope"):
+        pass
+    tl.add("nope2", 0.0, 1.0)
+    assert len(tl) == 0
+
+
+def test_export_chrome_trace_file_validates(tmp_path):
+    """Export -> file -> the check_telemetry schema validator."""
+    from dccrg_tpu import obs
+    from dccrg_tpu.obs.events import EventTimeline
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_telemetry
+    finally:
+        sys.path.pop(0)
+    tl = EventTimeline(enabled=True)
+    with tl.span("epoch.build"):
+        with tl.span("epoch.hood_build"):
+            pass
+    with tl.span("halo.exchange"):
+        pass
+    path = tmp_path / "trace.json"
+    obs.export_chrome_trace(str(path), tl)
+    data = json.loads(path.read_text())
+    assert len(data["traceEvents"]) == 6
+    assert check_telemetry.validate_chrome_trace(str(path)) == []
+
+
+# ------------------------------------------------------ streaming export
+
+
+def test_stream_snapshots_schema_and_final(tmp_path):
+    from dccrg_tpu import obs
+
+    reg = MetricsRegistry()
+    reg.inc("c", 5)
+    path = tmp_path / "s.jsonl"
+    with obs.TelemetryStream(str(path), period=3600.0, registry=reg,
+                             extra={"workload": "unit"}) as s:
+        s.write_snapshot(tag="a")
+        reg.inc("c", 2)
+        s.write_snapshot()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    # 2 explicit + 1 final (context exit)
+    assert len(lines) == 3
+    assert [l["seq"] for l in lines] == [0, 1, 2]
+    assert all(a["ts"] <= b["ts"] for a, b in zip(lines, lines[1:]))
+    assert lines[0]["tag"] == "a" and lines[0]["workload"] == "unit"
+    assert lines[0]["counters"]["c"][""] == 5
+    assert lines[1]["counters"]["c"][""] == 7
+    assert lines[-1]["final"] is True
+
+
+def test_stream_periodic_ticker(tmp_path):
+    """The daemon ticker really appends between explicit calls — the
+    hung-run evidence path."""
+    from dccrg_tpu import obs
+
+    reg = MetricsRegistry()
+    path = tmp_path / "tick.jsonl"
+    s = obs.TelemetryStream(str(path), period=0.05, registry=reg)
+    s.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if path.exists() and len(path.read_text().splitlines()) >= 2:
+            break
+        time.sleep(0.02)
+    s.stop(final=False)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) >= 2
+    assert [l["seq"] for l in lines] == list(range(len(lines)))
+
+
+def test_stream_validator_rejects_bad_streams(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_telemetry
+    finally:
+        sys.path.pop(0)
+    ok = {"seq": 0, "ts": 1.0, "phases": {}, "counters": {"c": {"": 1}},
+          "gauges": {}, "histograms": {}}
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        json.dumps(ok) + "\n"
+        + json.dumps({**ok, "seq": 1, "ts": 2.0,
+                      "counters": {"c": {"": 3}}}) + "\n"
+        # killed mid-write: trailing partial line is tolerated
+        + '{"seq": 2, "ts": 3.0, "pha'
+    )
+    assert check_telemetry.validate_stream(str(good)) == []
+    bad_seq = tmp_path / "bad_seq.jsonl"
+    bad_seq.write_text(json.dumps(ok) + "\n" + json.dumps(ok) + "\n")
+    assert any("seq" in f
+               for f in check_telemetry.validate_stream(str(bad_seq)))
+    bad_ts = tmp_path / "bad_ts.jsonl"
+    bad_ts.write_text(
+        json.dumps({**ok, "ts": 9.0}) + "\n"
+        + json.dumps({**ok, "seq": 1, "ts": 2.0}) + "\n"
+    )
+    assert any("ts" in f
+               for f in check_telemetry.validate_stream(str(bad_ts)))
+    bad_ctr = tmp_path / "bad_ctr.jsonl"
+    bad_ctr.write_text(
+        json.dumps(ok) + "\n"
+        + json.dumps({**ok, "seq": 1, "ts": 2.0,
+                      "counters": {"c": {"": 0}}}) + "\n"
+    )
+    assert any("decreased" in f
+               for f in check_telemetry.validate_stream(str(bad_ctr)))
+    missing = tmp_path / "missing.jsonl"
+    missing.write_text('{"seq": 0, "ts": 1.0}\n')
+    assert any("missing keys" in f
+               for f in check_telemetry.validate_stream(str(missing)))
+
+
+def test_trace_validator_rejects_bad_traces(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_telemetry
+    finally:
+        sys.path.pop(0)
+
+    def write(events):
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps({"traceEvents": events}))
+        return str(p)
+
+    b = {"name": "x", "ph": "B", "pid": 1, "tid": 0, "ts": 1.0}
+    e = {"name": "x", "ph": "E", "pid": 1, "tid": 0, "ts": 2.0}
+    assert check_telemetry.validate_chrome_trace(write([b, e])) == []
+    # unmatched begin
+    assert any("unmatched" in f for f in
+               check_telemetry.validate_chrome_trace(write([b])))
+    # E closing the wrong name
+    assert any("closes" in f for f in check_telemetry.validate_chrome_trace(
+        write([b, {**e, "name": "y"}])))
+    # backwards in-thread timestamp
+    assert any("backwards" in f
+               for f in check_telemetry.validate_chrome_trace(
+                   write([{**b, "ts": 5.0}, {**e, "ts": 1.0}])))
+    # bare E with empty stack
+    assert any("empty stack" in f
+               for f in check_telemetry.validate_chrome_trace(write([e])))
+
+
+# ------------------------------------------------------------ HBM gauges
+
+
+def test_sample_hbm_records_per_device_gauges():
+    from dccrg_tpu import obs
+
+    class FakeDev:
+        def __init__(self, i, stats):
+            self.id = i
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    reg = MetricsRegistry()
+    out = obs.sample_hbm(registry=reg, devices=[
+        FakeDev(0, {"bytes_in_use": 100, "bytes_limit": 1000}),
+        FakeDev(1, None),                      # CPU-style backend
+        FakeDev(2, {"bytes_in_use": 300, "peak_bytes_in_use": 400}),
+    ])
+    assert out == {0: {"bytes_in_use": 100, "bytes_limit": 1000},
+                   2: {"bytes_in_use": 300, "peak_bytes_in_use": 400}}
+    assert reg.gauge_value("hbm.bytes_in_use", device=0) == 100
+    assert reg.gauge_value("hbm.bytes_in_use", device=2) == 300
+    assert reg.gauge_value("hbm.peak_bytes_in_use", device=2) == 400
+    # disabled registry records nothing
+    reg2 = MetricsRegistry(enabled=False)
+    assert obs.sample_hbm(registry=reg2, devices=[
+        FakeDev(0, {"bytes_in_use": 1})]) == {}
+    assert reg2.report()["gauges"] == {}
+    # the real backend path must never raise, whatever it reports
+    obs.sample_hbm(registry=reg)
+
+
+# -------------------------------------------- fused-run reconciliation
+
+
+def test_fused_run_reconciliation_counters():
+    """Whole-run dispatches (ghost traffic inside jit) must reconcile
+    steps x schedule bytes into fused.* once per run() call."""
+    from dccrg_tpu import obs
+    from dccrg_tpu.models import GameOfLife
+
+    obs.metrics.reset()
+    obs.enable()
+    g = _small_grid(max_ref=0, hood=1, length=(8, 8, 1))
+    gol = GameOfLife(g)
+    st = gol.new_state(alive_cells=[12, 13, 14])
+    gol.run(st, 7)
+    m = obs.metrics
+    path = "fused" if gol._fused_run is not None else "dense"
+    assert m.counter_value("fused.runs", model="game_of_life",
+                           path=path) == 1
+    assert m.counter_value("fused.steps", model="game_of_life",
+                           path=path) == 7
+    expected = 7 * g.halo(None).bytes_moved({"is_alive": st["is_alive"]})
+    assert m.counter_value("fused.halo_bytes_equiv", model="game_of_life",
+                           path=path) == expected
+    assert expected > 0  # the 8-device board really has a schedule
+
+
+def test_fused_run_reconciliation_vlasov_and_advection():
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import Advection, Vlasov
+
+    obs.metrics.reset()
+    obs.enable()
+    n = 8
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    v = Vlasov(g, nv=2, dtype=np.float32, use_pallas=False)
+    assert v.info is not None
+    s = v.initialize_state()
+    v.run(s, 3, np.float32(0.2 * v.max_time_step()))
+    m = obs.metrics
+    assert m.counter_value("fused.steps", model="vlasov", path="xla") == 3
+    # dense slab layout on >1 device: 2 ring planes per device per step
+    expected = 3 * g.n_devices * 2 * v.info.ny * v.info.nx * v.B * 4
+    assert m.counter_value("fused.halo_bytes_equiv", model="vlasov",
+                           path="xla") == expected
+
+    adv = Advection(g, dtype=np.float32, use_pallas=False)
+    sa = adv.initialize_state()
+    adv.run(sa, 4, np.float32(0.2 * adv.max_time_step(sa)))
+    runs = m.report()["counters"].get("fused.runs", {})
+    adv_series = {k: v for k, v in runs.items() if "model=advection" in k}
+    assert sum(adv_series.values()) == 1, adv_series
+    steps = m.report()["counters"]["fused.steps"]
+    assert sum(v for k, v in steps.items() if "model=advection" in k) == 4
+
+
+def test_fused_reconciliation_disabled_records_nothing():
+    from dccrg_tpu import obs
+    from dccrg_tpu.models import GameOfLife
+
+    obs.metrics.reset()
+    obs.disable()
+    try:
+        g = _small_grid(max_ref=0, hood=1, length=(8, 8, 1))
+        gol = GameOfLife(g)
+        gol.run(gol.new_state(alive_cells=[12]), 3)
+        assert obs.metrics.report()["counters"] == {}
+    finally:
+        obs.enable()
+
+
+# ------------------------------------------------------- profiler trace
+
+
+def test_profile_trace_materializes_trace_dir(tmp_path):
+    """obs.profile_trace must actually leave a trace on disk (previously
+    only exercised manually via TensorBoard/xprof) — and restore the
+    annotation flag after."""
+    import jax
+    import jax.numpy as jnp
+
+    from dccrg_tpu import obs
+
+    log_dir = tmp_path / "trace"
+    prev = obs.metrics.annotate
+    with obs.profile_trace(str(log_dir)):
+        assert obs.metrics.annotate is True
+        jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+        with obs.metrics.phase("trace.probe"):
+            pass
+    assert obs.metrics.annotate is prev
+    files = [p for p in log_dir.rglob("*") if p.is_file()]
+    assert files, "profiler trace directory did not materialize"
 
 
 # --------------------------------------------------------------- CI gate

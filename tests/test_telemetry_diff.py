@@ -1,0 +1,170 @@
+"""The perf-regression gate (tools/telemetry_diff.py): verdict logic on
+synthetic phase tables (deterministic — no timing in CI), input-shape
+loaders, CLI exit codes, and the allowlist knob."""
+import json
+import os
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+@pytest.fixture(scope="module")
+def diff():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import telemetry_diff
+    finally:
+        sys.path.pop(0)
+    return telemetry_diff
+
+
+def _phases(**means):
+    """Phase table with count=10 and the given per-phase means."""
+    return {
+        name: {"total_s": m * 10, "count": 10, "mean_s": m}
+        for name, m in means.items()
+    }
+
+
+BASE = _phases(**{
+    "halo.exchange": 0.010,
+    "epoch.build": 0.020,
+    "amr.refine": 0.005,
+})
+
+
+def test_identical_rounds_pass(diff):
+    v = diff.compare(BASE, BASE)
+    assert v["verdict"] == "PASS"
+    assert v["failures"] == []
+    assert all(r["status"] in ("ok", "below-noise-floor")
+               for r in v["rows"])
+
+
+def test_regression_fails_and_names_the_phase(diff):
+    cur = _phases(**{
+        "halo.exchange": 0.020,      # 2.0x: regression
+        "epoch.build": 0.021,        # 1.05x: inside threshold
+        "amr.refine": 0.005,
+    })
+    v = diff.compare(cur, BASE, threshold=0.35)
+    assert v["verdict"] == "FAIL"
+    assert len(v["failures"]) == 1
+    assert "halo.exchange" in v["failures"][0]
+    by_phase = {r["phase"]: r for r in v["rows"]}
+    assert by_phase["halo.exchange"]["status"] == "REGRESSED"
+    assert by_phase["halo.exchange"]["ratio"] == pytest.approx(2.0)
+    assert by_phase["epoch.build"]["status"] == "ok"
+
+
+def test_allowlist_knob_suppresses_failure(diff):
+    cur = _phases(**{
+        "halo.exchange": 0.030,
+        "epoch.build": 0.020,
+        "amr.refine": 0.005,
+    })
+    v = diff.compare(cur, BASE, allow=("halo.exchange",))
+    assert v["verdict"] == "PASS"
+    statuses = {r["phase"]: r["status"] for r in v["rows"]}
+    assert statuses["halo.exchange"] == "allowed-regression"
+
+
+def test_missing_gated_phase_is_coverage_loss(diff):
+    cur = _phases(**{"halo.exchange": 0.010, "epoch.build": 0.020})
+    v = diff.compare(cur, BASE)
+    assert v["verdict"] == "FAIL"
+    assert any("amr.refine" in f and "missing" in f for f in v["failures"])
+    # ... unless allowlisted
+    assert diff.compare(cur, BASE, allow=("amr.refine",))["verdict"] == "PASS"
+
+
+def test_noise_floor_skips_tiny_phases(diff):
+    base = _phases(**{"checkpoint.write": 0.00005})
+    cur = _phases(**{"checkpoint.write": 0.00050})  # 10x, but microseconds
+    v = diff.compare(cur, base, min_total=1e-3)
+    assert v["verdict"] == "PASS"
+    assert v["rows"][0]["status"] == "below-noise-floor"
+
+
+def test_new_and_ungated_phases_inform_only(diff):
+    cur = {**BASE, **_phases(**{"brand.new_phase": 5.0})}
+    v = diff.compare(cur, BASE)
+    assert v["verdict"] == "PASS"
+    assert {r["phase"]: r["status"] for r in v["rows"]}[
+        "brand.new_phase"] == "new"
+    # a phase outside the gated set regresses without failing
+    cur2 = dict(BASE)
+    cur2 = {**cur2, **_phases(**{"halo.exchange": 0.010,
+                                 "epoch.build": 0.020,
+                                 "amr.refine": 0.100})}
+    v2 = diff.compare(cur2, BASE, phases=("halo.exchange",))
+    assert v2["verdict"] == "PASS"
+    assert {r["phase"]: r["status"] for r in v2["rows"]}[
+        "amr.refine"] == "ungated"
+
+
+# ----------------------------------------------------------- input shapes
+
+
+def test_load_phases_all_shapes(diff, tmp_path):
+    # telemetry.json shape
+    t = tmp_path / "telemetry.json"
+    t.write_text(json.dumps({"phases": BASE, "counters": {}}))
+    assert diff.load_phases(str(t)) == BASE
+    # bench-record shape
+    b = tmp_path / "BENCH_DETAIL.json"
+    b.write_text(json.dumps(
+        {"metric": "x", "detail": {"telemetry": {"phases": BASE}}}))
+    assert diff.load_phases(str(b)) == BASE
+    # streaming JSONL: the LAST complete snapshot wins, a trailing
+    # truncated line (killed mid-write) is skipped
+    s = tmp_path / "stream.jsonl"
+    early = {"seq": 0, "ts": 1.0, "phases": _phases(**{"halo.exchange": 1.0})}
+    late = {"seq": 1, "ts": 2.0, "phases": BASE}
+    s.write_text(json.dumps(early) + "\n" + json.dumps(late)
+                 + "\n" + '{"seq": 2, "trunc')
+    assert diff.load_phases(str(s)) == BASE
+    # shape with no phases anywhere
+    n = tmp_path / "nothing.json"
+    n.write_text(json.dumps({"metric": "x"}))
+    with pytest.raises(ValueError):
+        diff.load_phases(str(n))
+
+
+def test_cli_verdict_and_exit_codes(diff, tmp_path):
+    base_f = tmp_path / "base.json"
+    base_f.write_text(json.dumps({"phases": BASE}))
+    cur_pass = tmp_path / "cur_pass.json"
+    cur_pass.write_text(json.dumps({"phases": BASE}))
+    cur_fail = tmp_path / "cur_fail.json"
+    cur_fail.write_text(json.dumps(
+        {"phases": _phases(**{"halo.exchange": 0.050,
+                              "epoch.build": 0.020,
+                              "amr.refine": 0.005})}))
+    out = tmp_path / "verdict.json"
+    assert diff.main(["--current", str(cur_pass), "--baseline", str(base_f),
+                      "--json", str(out)]) == 0
+    assert json.loads(out.read_text())["verdict"] == "PASS"
+    assert diff.main(["--current", str(cur_fail), "--baseline", str(base_f),
+                      "--json", str(out)]) == 1
+    rec = json.loads(out.read_text())
+    assert rec["verdict"] == "FAIL"
+    assert any("halo.exchange" in f for f in rec["failures"])
+    # the allowlist flag flips it back to PASS
+    assert diff.main(["--current", str(cur_fail), "--baseline", str(base_f),
+                      "--allow", "halo.exchange"]) == 0
+    # unreadable input is a distinct exit code (2), not a crash
+    assert diff.main(["--current", str(tmp_path / "absent.json"),
+                      "--baseline", str(base_f)]) == 2
+
+
+def test_gate_on_repo_telemetry_round_trip(diff, tmp_path):
+    """The real repo telemetry.json diffed against itself must PASS —
+    the shape the per-round bench gate exercises."""
+    tel = os.path.join(ROOT, "telemetry.json")
+    if not os.path.exists(tel):
+        pytest.skip("no telemetry.json in repo root")
+    assert diff.main(["--current", tel, "--baseline", tel]) == 0

@@ -10,6 +10,14 @@ Checks (exit 1 on any failure):
   workload exercises them;
 * the report exports to ``telemetry.json`` (path via ``--out``) and the
   file round-trips through ``json.load``;
+* the streaming exporter leaves a schema-valid JSONL file next to it
+  (``<out>.stream.jsonl``: every line a complete snapshot, ``seq``
+  strictly increasing, ``ts`` non-decreasing, counters monotonic) and
+  the event timeline exports a valid Chrome trace
+  (``<out>.trace.json``: matched begin/end pairs, monotonic in-thread
+  timestamps) — :func:`validate_stream` / :func:`validate_chrome_trace`
+  are also importable and runnable standalone on any such file
+  (``--validate-stream`` / ``--validate-trace``);
 * unless ``--skip-overhead``: enabling telemetry must not slow the
   workload's step loop by more than ``--threshold`` (default 1.05 =
   5%) vs the disabled mode — the zero-cost-when-disabled and
@@ -48,6 +56,133 @@ REQUIRED_NONZERO_COUNTERS = (
     "amr.cells_refined",
     "checkpoint.bytes_written",
 )
+
+
+#: keys every streaming snapshot line must carry
+STREAM_REQUIRED_KEYS = ("seq", "ts", "phases", "counters", "gauges",
+                        "histograms")
+
+
+def validate_stream(path: str) -> list:
+    """Schema-validate a telemetry JSONL stream (``obs.stream_to``
+    output); returns failure strings (empty = valid).  A truncated FINAL
+    line is tolerated when the file does not end in a newline — that is
+    exactly the killed-mid-write case the stream exists to survive — but
+    every complete line must parse and the sequence must be coherent."""
+    failures: list = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [f"stream unreadable: {e}"]
+    lines = text.split("\n")
+    trailing_partial = lines and lines[-1] != ""
+    body = [ln for ln in (lines[:-1] if trailing_partial else lines) if ln]
+    if trailing_partial:
+        try:
+            json.loads(lines[-1])
+            body.append(lines[-1])  # complete after all, just no newline
+        except json.JSONDecodeError:
+            pass  # killed mid-write: the complete lines carry the evidence
+    if not body:
+        return [f"stream {path} holds no complete snapshot line"]
+    prev_seq, prev_ts = None, None
+    prev_counters: dict = {}
+    for i, ln in enumerate(body):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError as e:
+            failures.append(f"line {i}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            failures.append(f"line {i}: not an object")
+            continue
+        missing = [k for k in STREAM_REQUIRED_KEYS if k not in rec]
+        if missing:
+            failures.append(f"line {i}: missing keys {missing}")
+            continue
+        if prev_seq is not None and rec["seq"] <= prev_seq:
+            failures.append(
+                f"line {i}: seq {rec['seq']} not above {prev_seq}"
+            )
+        if prev_ts is not None and rec["ts"] < prev_ts:
+            failures.append(
+                f"line {i}: ts {rec['ts']} went backwards from {prev_ts}"
+            )
+        # counters are cumulative monotonic totals — a decrease means a
+        # reset mid-stream or a writer bug
+        for name, series in rec["counters"].items():
+            for label, v in series.items():
+                pv = prev_counters.get((name, label))
+                if pv is not None and v < pv:
+                    failures.append(
+                        f"line {i}: counter {name}[{label}] decreased "
+                        f"({pv} -> {v})"
+                    )
+                prev_counters[(name, label)] = v
+        prev_seq, prev_ts = rec["seq"], rec["ts"]
+    return failures
+
+
+def validate_chrome_trace(path: str) -> list:
+    """Schema-validate a Chrome trace-event export
+    (``obs.export_chrome_trace`` output): every ``B`` has a matching
+    ``E`` of the same name in stack order per (pid, tid), and in-thread
+    timestamps never go backwards.  Returns failure strings."""
+    failures: list = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"trace unreadable: {e}"]
+    events = data.get("traceEvents") if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        return ["trace has no traceEvents list"]
+    stacks: dict = {}
+    last_ts: dict = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            failures.append(f"event {i}: not a trace event")
+            continue
+        ph = ev["ph"]
+        if ph not in ("B", "E"):
+            continue  # X/i/M events are legal, just not produced here
+        key = (ev.get("pid"), ev.get("tid"))
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            failures.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if ts < last_ts.get(key, float("-inf")):
+            failures.append(
+                f"event {i}: ts {ts} went backwards on tid {key}"
+            )
+        last_ts[key] = ts
+        stack = stacks.setdefault(key, [])
+        if ph == "B":
+            stack.append((ev.get("name"), ts))
+        else:
+            if not stack:
+                failures.append(
+                    f"event {i}: E {ev.get('name')!r} with empty stack "
+                    f"on tid {key}"
+                )
+                continue
+            bname, bts = stack.pop()
+            if bname != ev.get("name"):
+                failures.append(
+                    f"event {i}: E {ev.get('name')!r} closes B {bname!r}"
+                )
+            if ts < bts:
+                failures.append(
+                    f"event {i}: span {bname!r} ends before it begins"
+                )
+    for key, stack in stacks.items():
+        if stack:
+            failures.append(
+                f"tid {key}: {len(stack)} unmatched B events "
+                f"({[n for n, _ in stack]})"
+            )
+    return failures
 
 
 def _ensure_env() -> None:
@@ -129,6 +264,8 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     failures: list = []
     obs.metrics.reset()
     obs.enable()
+    obs.timeline.clear()
+    obs.enable_timeline()
 
     g, adv, state, dt = build_workload()
     state = drive(g, adv, state, dt, steps)
@@ -172,6 +309,26 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     except (OSError, ValueError, KeyError) as e:
         failures.append(f"telemetry.json unreadable: {e}")
 
+    # streaming exporter: a few explicit snapshots (no timer sleeps —
+    # the probe must stay fast/deterministic) driven through real work
+    # between ticks, then schema-validated like any soak/bench stream
+    stream_path = str(out_path) + ".stream.jsonl"
+    s = obs.TelemetryStream(stream_path, period=3600.0, truncate=True,
+                            extra={"workload": "check_telemetry probe"})
+    s.write_snapshot(checkpoint="pre")
+    state = drive(g, adv, state, dt, 2)
+    s.write_snapshot(checkpoint="mid")
+    s.stop(final=True)
+    failures += [f"stream: {f}" for f in validate_stream(stream_path)]
+
+    # event timeline: the probe's spans as a Chrome trace, validated for
+    # matched begin/end pairs and monotonic in-thread timestamps
+    trace_path = str(out_path) + ".trace.json"
+    if not obs.timeline.enabled or len(obs.timeline) == 0:
+        failures.append("event timeline recorded no spans during probe")
+    obs.export_chrome_trace(trace_path)
+    failures += [f"trace: {f}" for f in validate_chrome_trace(trace_path)]
+
     if not skip_overhead:
         # enabled-vs-disabled step-loop cost.  The loop is dominated by
         # collective rendezvous on an oversubscribed host, so single
@@ -211,7 +368,26 @@ def main(argv=None) -> int:
                     help="max allowed enabled/disabled step-loop ratio")
     ap.add_argument("--skip-overhead", action="store_true",
                     help="only check phase/counter completeness + export")
+    ap.add_argument("--validate-stream", default=None, metavar="FILE",
+                    help="only schema-validate an existing telemetry "
+                         "JSONL stream and exit")
+    ap.add_argument("--validate-trace", default=None, metavar="FILE",
+                    help="only schema-validate an existing Chrome "
+                         "trace-event export and exit")
     args = ap.parse_args(argv)
+    if args.validate_stream or args.validate_trace:
+        failures = []
+        if args.validate_stream:
+            failures += [f"stream: {f}"
+                         for f in validate_stream(args.validate_stream)]
+        if args.validate_trace:
+            failures += [f"trace: {f}"
+                         for f in validate_chrome_trace(args.validate_trace)]
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        if not failures:
+            print("telemetry stream/trace validation passed")
+        return 1 if failures else 0
     failures = run_check(args.out, steps=args.steps,
                          skip_overhead=args.skip_overhead,
                          reps=args.reps, threshold=args.threshold)

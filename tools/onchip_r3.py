@@ -96,7 +96,10 @@ def done(key):
 
 def run_child(code, timeout=1500):
     """Each measurement in its own process: a tunnel drop kills one
-    measurement, not the battery."""
+    measurement, not the battery.  The prelude's atexit hook prints the
+    child's phase breakdown as a ``TELEMETRY:`` line — attached to the
+    result as ``obs_phases`` so BENCH rounds carry on-chip epoch/halo
+    splits per battery key, not just the CPU probe's."""
     try:
         r = subprocess.run([sys.executable, "-c", code], text=True,
                            capture_output=True, timeout=timeout,
@@ -106,16 +109,37 @@ def run_child(code, timeout=1500):
     line = next((ln for ln in reversed(r.stdout.splitlines())
                  if ln.startswith("{")), None)
     if r.returncode == 0 and line:
-        return json.loads(line)
+        out = json.loads(line)
+        tel = next((ln for ln in reversed(r.stdout.splitlines())
+                    if ln.startswith("TELEMETRY:")), None)
+        if tel and isinstance(out, dict) and "error" not in out:
+            try:
+                out["obs_phases"] = json.loads(tel[len("TELEMETRY:"):])
+            except json.JSONDecodeError:
+                pass
+        return out
     return {"error": (r.stderr or r.stdout)[-800:]}
 
 
+#: child prelude: import path + a streaming exporter (a killed child
+#: leaves its incremental phase evidence in tools/onchip_stream.jsonl)
+#: and an atexit phase dump the parent folds into the recorded value
 PRELUDE = """
 import sys, json, time, statistics
 sys.path.insert(0, %r)
 import jax
 import numpy as np
-""" % str(ROOT)
+try:
+    import atexit
+    from dccrg_tpu import obs as _obs
+    _obs.stream_to(%r, period=30.0, truncate=True,
+                   extra={"source": "onchip_battery"})
+    atexit.register(lambda: print(
+        "TELEMETRY:" + json.dumps(_obs.metrics.report()["phases"]),
+        flush=True))
+except Exception as _e:
+    print("battery telemetry unavailable:", _e, file=sys.stderr)
+""" % (str(ROOT), str(ROOT / "tools" / "onchip_stream.jsonl"))
 
 #: key -> (child code, timeout).  bench.measure_* are the single source
 #: of truth for configurations; each runs alone in a child.
@@ -203,7 +227,12 @@ def battery():
         if done(key):
             print(f"[onchip] {key}: already recorded, skipping", flush=True)
             continue
-        record(key, run_child(PRELUDE + body, timeout))
+        value = run_child(PRELUDE + body, timeout)
+        if key == SWEEP_KEY and isinstance(value, dict):
+            # the sweep's value is a pure {shape: rate} map — a phase
+            # table there would read as a shape to _ok and the merge
+            value.pop("obs_phases", None)
+        record(key, value)
         if not done(key) and not tunnel_up():
             print("[onchip] tunnel dropped; stopping this pass", flush=True)
             return False

@@ -518,11 +518,20 @@ def one(seed):
         assert m1 <= m0 * (1 + 1e-5), (seed, m0, m1)  # open z only loses
     assert np.isfinite(np.asarray(state['f'])).all(), seed
     # fused blocked kernel (interpret) must be bit-identical to the XLA
-    # three-split body
+    # three-split body on current jax; the 0.4.x Pallas interpreter
+    # rounds a few ULP differently (see tests/test_vlasov.py), so old
+    # jax gets the same ULP tolerance there
     vf = Vlasov(g, nv=4, dtype=np.float32, use_pallas="interpret")
     assert vf._fused_block > 0, seed
     sf = vf.run(s0, 6, dt)
-    assert np.array_equal(np.asarray(sf['f']), np.asarray(state['f'])), seed
+    a32 = np.asarray(sf['f'], np.float32)
+    b32 = np.asarray(state['f'], np.float32)
+    if tuple(int(p) for p in jax.__version__.split('.')[:2]) >= (0, 5):
+        assert np.array_equal(a32, b32), seed
+    else:
+        ulp = np.spacing(np.maximum(np.abs(a32), np.abs(b32)))
+        assert (np.abs(a32 - b32) <= 4 * ulp).all(), (
+            seed, float(np.abs(a32 - b32).max()))
     # general/AMR path on a randomly refined grid: every bin's unsplit
     # update must equal the advection general step with that bin's
     # constant velocity (the oracle the path is built to match)
@@ -724,8 +733,50 @@ print("POISSON_FUZZ_OK")
 """
 
 
-def run(name: str, lo: int, hi: int) -> bool:
-    code = BODIES[name]
+#: prepended to every child body when streaming is on: appends an
+#: incremental registry snapshot as JSONL every few seconds (plus a
+#: final one at exit), so a hung or killed seed leaves the phase
+#: evidence of everything it exercised (epoch builds, halo traffic,
+#: AMR commits) behind for post-mortem — schema-gated by
+#: ``tools/check_telemetry.py --validate-stream``
+STREAM_PRELUDE = """\
+import sys as _sys
+_sys.path.insert(0, %r)
+try:
+    from dccrg_tpu import obs as _obs
+    _obs.stream_to(%r, period=%r, truncate=True,
+                   extra={"subsystem": %r, "seeds": %r})
+except Exception as _e:  # telemetry must never break the fuzz
+    print("soak stream unavailable:", _e)
+"""
+
+
+#: every body pins an 8-device virtual CPU mesh via the new-jax config
+#: knob; old jax (0.4.x) lacks it — swap in the XLA_FLAGS spelling
+#: before the backend initializes (the utils/compat.py bridge, applied
+#: at the driver so the bodies stay on the current-jax vocabulary)
+_NUM_DEVICES_LINE = "jax.config.update('jax_num_cpu_devices', 8)\n"
+_NUM_DEVICES_COMPAT = """\
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:   # old jax: pre-init XLA_FLAGS is the only knob
+    import os as _os
+    if 'xla_force_host_platform_device_count' not in _os.environ.get('XLA_FLAGS', ''):
+        _os.environ['XLA_FLAGS'] = (_os.environ.get('XLA_FLAGS', '')
+            + ' --xla_force_host_platform_device_count=8').strip()
+"""
+
+
+def run(name: str, lo: int, hi: int, stream_dir: str | None = None) -> bool:
+    code = BODIES[name].replace(_NUM_DEVICES_LINE, _NUM_DEVICES_COMPAT)
+    if stream_dir:
+        import os
+
+        os.makedirs(stream_dir, exist_ok=True)
+        spath = os.path.join(stream_dir, f"{name}_{lo}_{hi}.jsonl")
+        code = STREAM_PRELUDE % (
+            str(ROOT), spath, 5.0, name, [lo, hi],
+        ) + code
     r = subprocess.run(
         [sys.executable, "-c", code, str(lo), str(hi)],
         cwd=str(ROOT),
@@ -749,9 +800,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("subsystem", choices=list(BODIES) + ["all"])
     ap.add_argument("--seeds", type=int, nargs=2, default=(0, 10))
+    ap.add_argument("--stream-dir",
+                    default=str(ROOT / "tools" / "soak_stream"),
+                    help="per-subsystem incremental telemetry JSONL "
+                         "streams land here (one file per run)")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="disable the incremental telemetry streams")
     a = ap.parse_args()
     names = list(BODIES) if a.subsystem == "all" else [a.subsystem]
-    ok = all([run(n, *a.seeds) for n in names])
+    sdir = None if a.no_stream else a.stream_dir
+    ok = all([run(n, *a.seeds, stream_dir=sdir) for n in names])
     sys.exit(0 if ok else 1)
 
 

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff two rounds' telemetry phase breakdowns.
+
+Compares the CURRENT round's phase timings (``telemetry.json``, a
+``BENCH_DETAIL.json`` record, or a streaming JSONL snapshot — the last
+line wins) against a BASELINE of the same shapes and fails (exit 1) when
+any gated phase's mean time regresses by more than ``--threshold``
+(fractional: 0.35 = +35%).  Phases named via ``--allow`` are reported
+but never fail the gate (the allowlist knob for intentional changes).
+
+Baseline discovery (``--baseline`` omitted): first of
+``tools/telemetry_prev.json`` (the previous round's probe, archived by
+``bench.py`` before it overwrites ``telemetry.json``), then
+``BENCH_DETAIL.json``'s embedded phase table.  ``bench.py`` runs this
+gate per round and attaches the verdict to the bench record; CI can run
+it standalone:
+
+    python tools/telemetry_diff.py                      # auto-discover
+    python tools/telemetry_diff.py --current telemetry.json \
+        --baseline tools/telemetry_prev.json --threshold 0.5 \
+        --allow amr.refine --json verdict.json
+
+Mean per completed span (``total_s / count``) is compared, not totals —
+rounds legitimately run different phase counts.  Phases whose baseline
+total is below ``--min-total`` are skipped as noise (a 50-microsecond
+phase doubling is jitter, not a regression).  A phase present in the
+baseline but MISSING from the current round is a coverage loss and
+fails the gate (unless allowlisted); new phases only inform.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: the hot-seam phases the gate watches by default (halo / epoch / the
+#: in-loop step seams ISSUE 2 names); --phases overrides
+DEFAULT_PHASES = (
+    "halo.exchange",
+    "epoch.build",
+    "epoch.hood_build",
+    "loadbalance.migrate",
+    "amr.refine",
+    "checkpoint.write",
+    "checkpoint.read",
+)
+
+
+def load_phases(path: str) -> dict:
+    """Phase table ``{name: {total_s, count, mean_s}}`` from any of the
+    telemetry-bearing shapes this repo produces:
+
+    * ``telemetry.json`` — top-level ``phases``;
+    * ``BENCH_DETAIL.json`` / ``BENCH_r*.json`` records —
+      ``detail.telemetry.phases``;
+    * a streaming ``*.jsonl`` — the LAST complete line's ``phases``
+      (cumulative, so the last snapshot is the round's final state).
+    """
+    p = pathlib.Path(path)
+    text = p.read_text()
+    if p.suffix == ".jsonl" or "\n{" in text.strip():
+        last = None
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue  # killed mid-write: earlier complete lines count
+            if isinstance(rec, dict) and "phases" in rec:
+                last = rec
+        if last is None:
+            raise ValueError(f"{path}: no snapshot line carries 'phases'")
+        return dict(last["phases"])
+    data = json.loads(text)
+    if "phases" in data:
+        return dict(data["phases"])
+    tel = (data.get("detail") or {}).get("telemetry") or {}
+    if "phases" in tel:
+        return dict(tel["phases"])
+    raise ValueError(f"{path}: no phase table found (not telemetry.json, "
+                     "a bench record, or a telemetry JSONL stream)")
+
+
+def discover_baseline() -> str | None:
+    """The newest prior-round phase source available in the repo."""
+    prev = ROOT / "tools" / "telemetry_prev.json"
+    if prev.exists():
+        return str(prev)
+    detail = ROOT / "BENCH_DETAIL.json"
+    if detail.exists():
+        try:
+            load_phases(str(detail))
+            return str(detail)
+        except (ValueError, json.JSONDecodeError):
+            pass
+    for cand in sorted(glob.glob(str(ROOT / "BENCH_r*.json")), reverse=True):
+        try:
+            load_phases(cand)
+            return cand
+        except (ValueError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def compare(current: dict, baseline: dict, threshold: float = 0.35,
+            phases=None, allow=(), min_total: float = 1e-3) -> dict:
+    """Pure comparison -> verdict record.  ``current``/``baseline`` are
+    phase tables; ``phases`` limits the gate (None = every baseline
+    phase); ``allow`` lists phases that may regress without failing."""
+    gate = set(phases) if phases else set(baseline)
+    allow = set(allow)
+    rows = []
+    failures = []
+    for name in sorted(set(baseline) | set(current)):
+        b, c = baseline.get(name), current.get(name)
+        row = {"phase": name}
+        if b is not None:
+            row["base_mean_s"] = round(
+                b.get("mean_s", b["total_s"] / max(b.get("count", 1), 1)), 6
+            )
+            row["base_total_s"] = round(b["total_s"], 6)
+        if c is not None:
+            row["cur_mean_s"] = round(
+                c.get("mean_s", c["total_s"] / max(c.get("count", 1), 1)), 6
+            )
+        gated = name in gate and name not in allow
+        if b is None:
+            row["status"] = "new"
+        elif name not in gate:
+            row["status"] = "ungated"
+        elif b["total_s"] < min_total:
+            row["status"] = "below-noise-floor"
+        elif c is None:
+            row["status"] = "allowed-missing" if not gated else "MISSING"
+            if gated:
+                failures.append(f"{name}: present in baseline, missing "
+                                "from current round (coverage loss)")
+        else:
+            ratio = row["cur_mean_s"] / max(row["base_mean_s"], 1e-12)
+            row["ratio"] = round(ratio, 3)
+            if ratio > 1.0 + threshold:
+                row["status"] = "allowed-regression" if not gated else "REGRESSED"
+                if gated:
+                    failures.append(
+                        f"{name}: mean {row['base_mean_s']:.6f}s -> "
+                        f"{row['cur_mean_s']:.6f}s ({ratio:.2f}x, "
+                        f"threshold {1 + threshold:.2f}x)"
+                    )
+            else:
+                row["status"] = "ok"
+        rows.append(row)
+    return {
+        "verdict": "FAIL" if failures else "PASS",
+        "threshold": threshold,
+        "min_total_s": min_total,
+        "allow": sorted(allow),
+        "failures": failures,
+        "rows": rows,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--current", default=str(ROOT / "telemetry.json"),
+                    help="this round's telemetry (json or jsonl stream)")
+    ap.add_argument("--baseline", default=None,
+                    help="previous round (default: auto-discover)")
+    ap.add_argument("--threshold", type=float, default=0.35,
+                    help="max allowed fractional mean-time regression")
+    ap.add_argument("--min-total", type=float, default=1e-3,
+                    help="skip phases whose baseline total_s is below this")
+    ap.add_argument("--phases", default=",".join(DEFAULT_PHASES),
+                    help="comma-separated gated phases ('' = all)")
+    ap.add_argument("--allow", action="append", default=[],
+                    help="phase allowed to regress (repeatable, or "
+                         "comma-separated)")
+    ap.add_argument("--json", default=None,
+                    help="also write the verdict record to this path")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or discover_baseline()
+    if baseline_path is None:
+        print("telemetry_diff: no baseline round found — PASS (vacuous); "
+              "run bench.py once to establish one", file=sys.stderr)
+        return 0
+    try:
+        current = load_phases(args.current)
+        baseline = load_phases(baseline_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"telemetry_diff: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    allow = [a for chunk in args.allow for a in chunk.split(",") if a]
+    phases = [p for p in args.phases.split(",") if p] or None
+    verdict = compare(current, baseline, threshold=args.threshold,
+                      phases=phases, allow=allow, min_total=args.min_total)
+    verdict["current"] = str(args.current)
+    verdict["baseline"] = str(baseline_path)
+
+    for row in verdict["rows"]:
+        parts = [f"{row['phase']:24s} {row['status']:>18s}"]
+        if "base_mean_s" in row and "cur_mean_s" in row:
+            parts.append(f"{row['base_mean_s']:.6f}s -> "
+                         f"{row['cur_mean_s']:.6f}s")
+            if "ratio" in row:
+                parts.append(f"({row['ratio']:.2f}x)")
+        print("  ".join(parts))
+    print(f"telemetry_diff: {verdict['verdict']} "
+          f"({args.current} vs {baseline_path}, "
+          f"threshold {1 + args.threshold:.2f}x)")
+    for f in verdict["failures"]:
+        print(f"  REGRESSION: {f}", file=sys.stderr)
+    if args.json:
+        tmp = args.json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(verdict, f, indent=1)
+        os.replace(tmp, args.json)
+    return 1 if verdict["verdict"] == "FAIL" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
