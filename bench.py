@@ -1035,14 +1035,31 @@ def _attach_telemetry(record: dict) -> None:
         return
     try:
         t = json.loads(tpath.read_text())
+        phases = t.get("phases", {})
+        counters = t.get("counters", {})
         record.setdefault("detail", {})["telemetry"] = {
             "file": "telemetry.json",
             "workload": t.get("workload"),
-            "phases": t.get("phases", {}),
-            "halo_bytes_moved": t.get("counters", {}).get(
+            "phases": phases,
+            "halo_bytes_moved": counters.get(
                 "halo.bytes_moved", {}).get(""),
-            "halo_wire_bytes": t.get("counters", {}).get(
+            "halo_wire_bytes": counters.get(
                 "halo.wire_bytes", {}).get(""),
+            # the full-vs-incremental rebuild split (ISSUE 3): per-round
+            # means of both paths plus how often the delta engaged or
+            # declined, so BENCH rounds track the host-rebuild win
+            "epoch_rebuild": {
+                "build_mean_s": phases.get(
+                    "epoch.build", {}).get("mean_s"),
+                "delta_build_mean_s": phases.get(
+                    "epoch.delta_build", {}).get("mean_s"),
+                "delta_builds": counters.get(
+                    "epoch.delta_builds", {}).get(""),
+                "delta_cells_touched": counters.get(
+                    "epoch.delta_cells_touched", {}).get(""),
+                "delta_fallbacks": counters.get(
+                    "epoch.delta_fallbacks", {}),
+            },
         }
     except (OSError, ValueError) as e:
         print(f"could not attach telemetry.json: {e}", file=sys.stderr)

@@ -14,6 +14,7 @@ Usage: python benchmarks/microbench.py [--n 1000000] [--refine-length 32]
 """
 import argparse
 import json
+import os
 import pathlib
 import sys
 import time
@@ -236,6 +237,109 @@ def bench_epoch_rebuild(length: int = 64):
     }))
 
 
+def bench_epoch_churn(length: int = 48,
+                      fractions=(0.002, 0.005, 0.01, 0.05), seed: int = 0):
+    """Randomized refine/unrefine storms on a refined ball: full
+    ``build_epoch`` vs incremental ``build_epoch_delta`` wall time over
+    a storm-size sweep (ISSUE 3's acceptance workload).  Storms are
+    spatially clustered (a random sub-ball), the shape real AMR churn
+    takes — a tracked feature refines where it is, not uniformly at
+    random.  Every incremental epoch is asserted table-for-table
+    identical to the full build before its timing is reported.
+
+    ``touched_fraction`` in the detail is the delta path's own closure
+    accounting (added + removed + one-hood-radius survivors): a storm
+    REFINING f of the cells touches ~9f after children and closure
+    expansion, and the path falls back above
+    ``DCCRG_EPOCH_DELTA_MAX_FRACTION`` (default 25%) of the grid."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.amr.refinement import commit_adaptation
+    from dccrg_tpu.parallel.epoch import build_epoch
+    from dccrg_tpu.parallel.epoch_delta import build_epoch_delta
+    from dccrg_tpu.utils.verify import compare_epochs
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(1)
+        .set_maximum_refinement_level(2)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / length,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    rng = np.random.default_rng(seed)
+    ids = g.get_cells()
+    ctr = g.geometry.get_center(ids)
+    g.refine_completely_many(ids[np.linalg.norm(ctr - 0.5, axis=1) < 0.2])
+    g.stop_refining()
+
+    def full(g):
+        return build_epoch(
+            g.mapping, g.topology, g.leaves, g.n_devices, g.neighborhoods,
+            uniform_geometry=g._uniform_geometry(),
+        )
+
+    for frac in fractions:
+        ids = g.get_cells()
+        n_cells = len(ids)
+        ctr = g.geometry.get_center(ids)
+        rr = np.linalg.norm(ctr - rng.uniform(0.3, 0.7, 3), axis=1)
+        storm = ids[rr < np.quantile(rr, frac)]
+        lvl = g.mapping.get_refinement_level(storm)
+        # randomized mix: refine what can refine, unrefine a slice of
+        # what is already fine
+        g.refine_completely_many(storm[lvl < 2])
+        fine = storm[lvl == 2]
+        if len(fine):
+            g.unrefine_completely_many(fine[: max(1, len(fine) // 4)])
+        old = g.epoch
+        commit_adaptation(g)
+        t_delta, t_full = [], []
+        e_delta = e_full = None
+        touched0 = obs.metrics.counter_value(
+            "epoch.delta_cells_touched") or 0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            e_delta = build_epoch_delta(
+                old, g.leaves, g.n_devices, g.neighborhoods,
+                uniform_geometry=g._uniform_geometry(),
+            )
+            t_delta.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            e_full = full(g)
+            t_full.append(time.perf_counter() - t0)
+        touched = ((obs.metrics.counter_value("epoch.delta_cells_touched")
+                    or 0) - touched0) // 3
+        fell_back = e_delta is None
+        if not fell_back:
+            compare_epochs(e_delta, e_full)  # bit-identical, always
+        g.epoch = e_full
+        g._halo_cache = {}
+        g._unrefine_cache = None
+        d, f = float(np.median(t_delta)), float(np.median(t_full))
+        print(json.dumps({
+            "metric": f"epoch_churn_speedup_{frac:g}",
+            "value": round(f / d, 2) if not fell_back else 1.0,
+            "unit": "x (full/delta)",
+            "detail": {
+                "n_cells": n_cells,
+                "storm_cells": int(len(storm)),
+                "storm_fraction": round(len(storm) / n_cells, 4),
+                "touched_cells": int(touched),
+                "touched_fraction": round(touched / max(len(g.leaves), 1), 4),
+                "delta_s": round(d, 3),
+                "full_s": round(f, 3),
+                "fell_back": fell_back,
+                "native": os.environ.get("DCCRG_TPU_NATIVE", "1") != "0",
+            },
+        }))
+
+
 def pic_setup(n_particles: int, length: int = 32, *, max_ref: int = 0,
               refine_ball: float | None = None,
               balance_method: str | None = None, seed: int = 0):
@@ -329,11 +433,15 @@ def main():
     ap.add_argument("--refine-length", type=int, default=32)
     ap.add_argument("--checkpoint-length", type=int, default=100)
     ap.add_argument("--particles", type=int, default=1_000_000)
+    ap.add_argument("--churn-length", type=int, default=48,
+                    help="level-0 edge for the epoch-churn sweep "
+                         "(48^3 + refined ball > 130k cells)")
     args = ap.parse_args()
     bench_geometry(args.n)
     bench_refinement(args.refine_length)
     bench_checkpoint(args.checkpoint_length)
     bench_epoch_rebuild()
+    bench_epoch_churn(args.churn_length)
     bench_particles(args.particles)
 
 

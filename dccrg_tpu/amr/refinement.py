@@ -30,7 +30,27 @@ from ..core.mapping import Mapping
 from ..core.neighbors import LeafSet, find_all_neighbors
 from ..utils.setops import csr_take, unique_u64
 
-__all__ = ["AmrQueues", "commit_adaptation"]
+__all__ = ["AmrQueues", "AdaptationDelta", "commit_adaptation"]
+
+
+@dataclass(frozen=True)
+class AdaptationDelta:
+    """The touched set of one AMR commit — the seed the incremental
+    epoch rebuild (``parallel/epoch_delta.py``) patches around.  Unlike
+    ``stop_refining``'s return values (children created / family cells
+    removed), this is the COMPLETE leaf-set symmetric difference: it also
+    carries the refined cells that stopped being leaves and the parents
+    that became leaves through unrefinement."""
+
+    added: np.ndarray    # (A,) uint64, sorted: ids newly in the leaf set
+    removed: np.ndarray  # (B,) uint64, sorted: ids no longer leaves
+
+    @classmethod
+    def empty(cls) -> "AdaptationDelta":
+        return cls(
+            added=np.zeros(0, dtype=np.uint64),
+            removed=np.zeros(0, dtype=np.uint64),
+        )
 
 
 @dataclass
@@ -170,11 +190,13 @@ def _find_for_nonleaves(mapping, topology, leaves, cells, hood_offsets):
     )
 
 
-def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
+def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray, AdaptationDelta]:
     """Run the full stop_refining pipeline on a grid; returns
-    (new_cells, removed_cells) and updates the grid's leaf set.  Children
-    stay on the refined cell's device; a parent created by unrefinement goes
-    to the owner of its first child (``dccrg.hpp:10263-10445``)."""
+    (new_cells, removed_cells, delta) and updates the grid's leaf set —
+    ``delta`` is the complete touched set (:class:`AdaptationDelta`)
+    consumed by the incremental epoch rebuild.  Children stay on the
+    refined cell's device; a parent created by unrefinement goes to the
+    owner of its first child (``dccrg.hpp:10263-10445``)."""
     mapping: Mapping = grid.mapping
     leaves: LeafSet = grid.leaves
     queues: AmrQueues = grid.amr
@@ -210,7 +232,7 @@ def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
         # skip rebuilding (and re-sorting) all N leaves
         queues.clear()
         empty = np.zeros(0, dtype=np.uint64)
-        return empty, empty.copy()
+        return empty, empty.copy(), AdaptationDelta.empty()
 
     # --- build the new leaf set
     new_children = mapping.get_all_children(refined).reshape(-1) if len(refined) else np.zeros(0, np.uint64)
@@ -254,4 +276,8 @@ def commit_adaptation(grid) -> tuple[np.ndarray, np.ndarray]:
             table.pop(rc, None)
 
     queues.clear()
-    return np.sort(new_children), np.sort(removed_cells)
+    delta = AdaptationDelta(
+        added=np.sort(np.concatenate([new_children, new_parents])),
+        removed=np.sort(np.concatenate([refined, removed_cells])),
+    )
+    return np.sort(new_children), np.sort(removed_cells), delta

@@ -38,6 +38,8 @@ __all__ = [
     "find_all_neighbors",
     "invert_neighbors",
     "face_directions",
+    "affected_closure",
+    "splice_neighbor_lists",
 ]
 
 
@@ -267,6 +269,126 @@ def find_all_neighbors(
     np.cumsum(row_counts, out=start[1:])
     return NeighborLists(
         start=start, nbr_pos=nbr_pos, nbr_cell=nbr_cell, offset=offset, slot=slot_out
+    )
+
+
+def affected_closure(
+    lists: NeighborLists,
+    to_start: np.ndarray,
+    to_src: np.ndarray,
+    changed_pos: np.ndarray,
+    n_cells: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One-neighborhood-radius closure of a touched cell set, from the
+    hood's existing CSR relations (no geometric search).
+
+    ``changed_pos`` are leaf positions whose cells are removed or replaced
+    by an AMR commit.  Returns two boolean masks over the ``n_cells`` old
+    leaf positions:
+
+    * ``list_closure`` — rows whose neighbors-of list can change: the
+      changed rows themselves plus every row LISTING a changed cell
+      (= the changed cells' neighbors-to).  A surviving row outside this
+      set keeps a bit-identical list, because every old leaf covering any
+      of its neighborhood slots appears in that list — so a coverage
+      change implies a changed cell was listed.
+    * ``target_closure`` — rows whose neighbors-to (inverse) list can
+      change: every row LISTED BY a ``list_closure`` row (the inverse
+      loses those rows' old contributions and regains them from the
+      re-search).  New-target gains from re-searched rows are added by
+      the caller once the new lists exist.
+    """
+    from ..utils.setops import csr_take
+
+    list_closure = np.zeros(n_cells, dtype=bool)
+    target_closure = np.zeros(n_cells, dtype=bool)
+    changed_pos = np.asarray(changed_pos, dtype=np.int64)
+    if len(changed_pos):
+        list_closure[changed_pos] = True
+        list_closure[csr_take(to_start, to_src, changed_pos)] = True
+        target_closure[
+            csr_take(lists.start, lists.nbr_pos, np.flatnonzero(list_closure))
+        ] = True
+    return list_closure, target_closure
+
+
+def splice_neighbor_lists(
+    old: NeighborLists,
+    old_row_of_new: np.ndarray,
+    pos_old_to_new: np.ndarray,
+    fresh: NeighborLists,
+    fresh_rows: np.ndarray,
+    n_new: int,
+) -> NeighborLists:
+    """Forward-CSR splice: the new leaf order's ``NeighborLists`` from
+    reusable old rows plus freshly searched closure rows.
+
+    ``old_row_of_new``: (n_new,) old position whose CSR row is copied
+    verbatim for each new position, -1 where the row comes from ``fresh``.
+    ``pos_old_to_new``: (n_old,) new position of each old leaf (applied to
+    copied ``nbr_pos`` entries; copied rows reference surviving leaves
+    only, so no -1 can be gathered).
+    ``fresh``: lists searched over ``fresh_rows`` (ascending new
+    positions) against the new leaf set.
+    """
+    from ..utils.setops import ragged_arange
+
+    old_row_of_new = np.asarray(old_row_of_new, dtype=np.int64)
+    fresh_rows = np.asarray(fresh_rows, dtype=np.int64)
+    kept_rows = np.flatnonzero(old_row_of_new >= 0)
+    src_rows = old_row_of_new[kept_rows]
+
+    counts = np.zeros(n_new, dtype=np.int64)
+    counts[kept_rows] = old.start[src_rows + 1] - old.start[src_rows]
+    counts[fresh_rows] = np.diff(fresh.start)
+    start = np.zeros(n_new + 1, dtype=np.int64)
+    np.cumsum(counts, out=start[1:])
+    E = int(start[-1])
+
+    nbr_pos = np.empty(E, dtype=np.int64)
+    nbr_cell = np.empty(E, dtype=np.uint64)
+    offset = np.empty((E, 3), dtype=np.int64)
+    slot = np.empty(E, dtype=np.int32)
+
+    def _ranges(rows, row_starts):
+        c = counts[rows]
+        rank = ragged_arange(c)
+        return np.repeat(row_starts, c) + rank, np.repeat(start[rows], c) + rank
+
+    if len(kept_rows):
+        # kept rows come in long contiguous runs (row insertion/removal
+        # shifts whole suffixes), and consecutive kept rows with
+        # consecutive old rows own contiguous CSR ranges on both sides —
+        # copy per run at memcpy speed, falling back to one flat fancy
+        # gather when the run structure degenerates
+        brk = np.flatnonzero(
+            (np.diff(kept_rows) != 1) | (np.diff(src_rows) != 1)
+        ) + 1
+        if len(brk) + 1 <= max(1024, len(kept_rows) // 8):
+            seg = np.concatenate(([0], brk, [len(kept_rows)]))
+            for s0, s1 in zip(seg[:-1].tolist(), seg[1:].tolist()):
+                d0 = int(start[kept_rows[s0]])
+                o0 = int(old.start[src_rows[s0]])
+                L = int(start[kept_rows[s1 - 1]] + counts[kept_rows[s1 - 1]]) - d0
+                nbr_pos[d0:d0 + L] = pos_old_to_new[old.nbr_pos[o0:o0 + L]]
+                nbr_cell[d0:d0 + L] = old.nbr_cell[o0:o0 + L]
+                offset[d0:d0 + L] = old.offset[o0:o0 + L]
+                slot[d0:d0 + L] = old.slot[o0:o0 + L]
+        else:
+            src_idx, dst_idx = _ranges(kept_rows, old.start[src_rows])
+            nbr_pos[dst_idx] = pos_old_to_new[old.nbr_pos[src_idx]]
+            nbr_cell[dst_idx] = old.nbr_cell[src_idx]
+            offset[dst_idx] = old.offset[src_idx]
+            slot[dst_idx] = old.slot[src_idx]
+    if len(fresh_rows):
+        src_idx, dst_idx = _ranges(fresh_rows, fresh.start[:-1])
+        nbr_pos[dst_idx] = fresh.nbr_pos[src_idx]
+        nbr_cell[dst_idx] = fresh.nbr_cell[src_idx]
+        offset[dst_idx] = fresh.offset[src_idx]
+        slot[dst_idx] = fresh.slot[src_idx]
+    return NeighborLists(
+        start=start, nbr_pos=nbr_pos, nbr_cell=nbr_cell, offset=offset,
+        slot=slot,
     )
 
 

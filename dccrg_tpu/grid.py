@@ -138,6 +138,7 @@ class Grid:
         self.amr = AmrQueues()
         self._last_new_cells = np.zeros(0, dtype=np.uint64)
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
+        self._last_adaptation_delta = None
         self._prev_epoch = None
 
         if leaf_set is not None:
@@ -250,6 +251,29 @@ class Grid:
             self.neighborhoods,
             uniform_geometry=self._uniform_geometry(),
         )
+        self._halo_cache = {}
+        self._id_pos_cache = None
+        self._unrefine_cache = None
+
+    def _rebuild_incremental(self, old_epoch):
+        """Derive the epoch for the current (already mutated) leaf set by
+        delta-patching ``old_epoch`` (``parallel/epoch_delta.py``) —
+        O(|touched| · K) instead of the full O(N · K) rebuild — falling
+        back to ``build_epoch`` (the semantic oracle) whenever the delta
+        path declines (closure too large, row-budget jump, dense-path
+        flip; see ``epoch_delta.FALLBACK_REASONS``)."""
+        from .parallel.epoch_delta import build_epoch_delta
+
+        epoch = None
+        if old_epoch is not None:
+            epoch = build_epoch_delta(
+                old_epoch, self.leaves, self.n_devices, self.neighborhoods,
+                uniform_geometry=self._uniform_geometry(),
+            )
+        if epoch is None:
+            self._rebuild()
+            return
+        self.epoch = epoch
         self._halo_cache = {}
         self._id_pos_cache = None
         self._unrefine_cache = None
@@ -711,7 +735,6 @@ class Grid:
         with metrics.phase("loadbalance.migrate"):
             owner = self._compute_new_owner(use_zoltan)
             self._lb_telemetry(self.leaves.owner, owner)
-            self._prev_epoch = self.epoch
             self._last_new_cells = np.zeros(0, dtype=np.uint64)
             self._last_removed_cells = np.zeros(0, dtype=np.uint64)
             # load balancing cancels pending adaptation (reference:
@@ -723,9 +746,12 @@ class Grid:
                 # to the identity (checkpoint reload hits this on its
                 # post-replay balance when the partitioner reproduces the
                 # current owners)
+                self._prev_epoch = None
                 return self
+            old_epoch = self.epoch
             self.leaves = LeafSet(cells=self.leaves.cells, owner=owner)
-            self._rebuild()
+            self._rebuild_incremental(old_epoch)
+            self._prev_epoch = _EpochCarry(old_epoch)
         return self
 
     def _lb_telemetry(self, old_owner, new_owner):
@@ -909,11 +935,21 @@ class Grid:
                 self._staged_lb = {"noop": True}
                 return self
             new_leaves = LeafSet(cells=self.leaves.cells, owner=owner)
-            new_epoch = build_epoch(
-                self.mapping, self.topology, new_leaves, self.n_devices,
-                self.neighborhoods,
+            # the staged epoch is a pure ownership migration off the live
+            # one: the delta path reuses every neighbor relation and
+            # re-derives only the owner-dependent tables
+            from .parallel.epoch_delta import build_epoch_delta
+
+            new_epoch = build_epoch_delta(
+                self.epoch, new_leaves, self.n_devices, self.neighborhoods,
                 uniform_geometry=self._uniform_geometry(),
             )
+            if new_epoch is None:
+                new_epoch = build_epoch(
+                    self.mapping, self.topology, new_leaves, self.n_devices,
+                    self.neighborhoods,
+                    uniform_geometry=self._uniform_geometry(),
+                )
         self._staged_lb = {
             "noop": False,
             "leaves": new_leaves,
@@ -976,7 +1012,7 @@ class Grid:
             raise RuntimeError("initialize_balance_load has not been called")
         if st.get("noop"):
             self._staged_lb = None
-            self._prev_epoch = self.epoch
+            self._prev_epoch = None
             self._last_new_cells = np.zeros(0, dtype=np.uint64)
             self._last_removed_cells = np.zeros(0, dtype=np.uint64)
             return state if state is not None else self
@@ -988,7 +1024,7 @@ class Grid:
                 "migration is partial; pass the state to finish_balance_load"
             )
         self._staged_lb = None
-        self._prev_epoch = self.epoch
+        self._prev_epoch = _EpochCarry(self.epoch)
         self._last_new_cells = np.zeros(0, dtype=np.uint64)
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
         self.leaves = st["leaves"]
@@ -1401,22 +1437,40 @@ class Grid:
         with metrics.phase("amr.refine"):
             if not presynced:
                 sync_adaptation(self.amr)
-            self._prev_epoch = self.epoch
-            new_cells, removed = commit_adaptation(self)
+            old_epoch = self.epoch
+            new_cells, removed, delta = commit_adaptation(self)
             self._last_new_cells = new_cells
             self._last_removed_cells = removed
+            self._last_adaptation_delta = delta
             if not len(new_cells) and not len(removed):
                 # nothing changed (nothing queued, or everything vetoed):
                 # the leaf set was left untouched, keep the current epoch
                 # and every derived table instead of paying a full rebuild
+                self._prev_epoch = None
                 return new_cells.copy()
-            self._rebuild()
+            self._rebuild_incremental(old_epoch)
+            self._prev_epoch = _EpochCarry(old_epoch)
         return new_cells.copy()
 
     def get_removed_cells(self) -> np.ndarray:
         """Cells removed by the last ``stop_refining`` (their parents are
         now leaves) — reference ``dccrg.hpp:3488-3520``."""
         return self._last_removed_cells.copy()
+
+    def get_last_adaptation_delta(self):
+        """The complete touched set of the last AMR commit
+        (``amr.refinement.AdaptationDelta``: every id added to / removed
+        from the leaf set, including refined parents and new unrefinement
+        parents) — the seed the incremental epoch rebuild patches
+        around.  None before the first commit."""
+        return getattr(self, "_last_adaptation_delta", None)
+
+    def release_prev_epoch(self) -> None:
+        """Drop the retained pre-change carry without remapping any
+        payload — for callers with no state to carry across the last
+        structural change that want the host memory back immediately.
+        ``remap_state`` becomes the identity until the next change."""
+        self._prev_epoch = None
 
     def remap_state(self, state, policy=None):
         """Carry a payload state across the last structural change.
@@ -1428,6 +1482,12 @@ class Grid:
         "zero").  This is the array-level form of the reference pattern of
         reading parent/child data after stop_refining
         (tests/advection/adapter.hpp:230-292).
+
+        Memory note: only a slim carry of the old epoch (leaf directory +
+        row assignment) is retained across a structural change — the old
+        hood tables are freed eagerly at rebuild time.  The carry stays
+        so further payloads can be remapped; call ``release_prev_epoch``
+        once every payload is across to drop it too.
         """
         if self._prev_epoch is None or self._prev_epoch is self.epoch:
             # no structural change (e.g. a no-move balance_load): identity
@@ -1593,6 +1653,24 @@ class Grid:
 
     def get_number_of_update_receive_cells(self, device: int, hood_id=None) -> int:
         return int(self.epoch.hoods[hood_id].pair_counts[:, device].sum())
+
+
+class _EpochCarry:
+    """Slim view of a pre-change epoch: exactly what ``remap_state``
+    needs to carry payloads across a structural change (the old leaf
+    directory, row assignment and row budget).  Retaining this instead
+    of the full ``Epoch`` frees the old hood tables — the ``[D, R,
+    Kmax]`` gather tables and send/recv schedules, i.e. the bulk of a
+    second epoch's host memory — eagerly at rebuild time instead of
+    holding them until the next structural change."""
+
+    __slots__ = ("leaves", "row_of", "n_devices", "R")
+
+    def __init__(self, epoch):
+        self.leaves = epoch.leaves
+        self.row_of = epoch.row_of
+        self.n_devices = epoch.n_devices
+        self.R = epoch.R
 
 
 class _SubGridView:

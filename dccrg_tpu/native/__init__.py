@@ -20,6 +20,7 @@ __all__ = [
     "native_sort_unique_u64",
     "native_invert_and_pairs",
     "native_fill_tables",
+    "native_delta_patch_tables",
     "native_available",
 ]
 
@@ -84,6 +85,19 @@ def _load():
     lib.extract_pairs.argtypes = [
         u64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
     ]
+    try:
+        lib.delta_patch_tables.restype = None
+        lib.delta_patch_tables.argtypes = [
+            i32p, u8p, i32p, i32p, i32p,     # old tables (flattened)
+            i64p, i64p, i64p,                # dst_rows, src_rows, counts
+            ctypes.c_int64,                  # n_reuse
+            i32p,                            # rowmap
+            ctypes.c_int64, ctypes.c_int64,  # Kold, Kmin
+            ctypes.c_int64,                  # Kmax (new width)
+            i32p, u8p, i32p, i32p, i32p,     # new tables (flattened)
+        ]
+    except AttributeError:
+        pass  # pre-delta .so still loads; numpy patch path engages
     lib.hood_fill_tables.restype = None
     lib.hood_fill_tables.argtypes = [
         i64p, i64p, i64p, i32p,          # start, nbr_pos, offset3, slot
@@ -205,6 +219,39 @@ def native_invert_and_pairs(start, nbr_pos, owner, n_devices):
     assert k == n_pairs.value
     pairs = np.stack([out_dev[:k], out_pos[:k]], axis=1)
     return to_start, to_src[:n_to], pairs, is_outer.astype(bool)[:N]
+
+
+def native_delta_patch_tables(
+    old_rows, old_valid, old_offset, old_len, old_slot,
+    dst_rows, src_rows, row_counts, rowmap, kmin,
+    new_rows, new_valid, new_offset, new_len, new_slot,
+):
+    """Fused per-device gather-table patch (C++): one OpenMP sweep copies
+    every reused row ``src_rows[i] -> dst_rows[i]`` across all five
+    tables at once — only the row's ``row_counts[i]`` live columns, the
+    rest is pad on both sides — pushing ``nbr_rows`` values through the
+    old-row -> new-row map.  The incremental-epoch replacement for five
+    separate numpy passes.  Returns True, or False if the native library
+    is unavailable (caller runs the numpy patch)."""
+    lib = _load()
+    if lib is None or getattr(lib, "delta_patch_tables", None) is None:
+        return False
+    lib.delta_patch_tables(
+        old_rows.reshape(-1),
+        old_valid.view(np.uint8).reshape(-1),
+        old_offset.reshape(-1),
+        old_len.reshape(-1),
+        old_slot.reshape(-1),
+        np.ascontiguousarray(dst_rows, dtype=np.int64),
+        np.ascontiguousarray(src_rows, dtype=np.int64),
+        np.ascontiguousarray(row_counts, dtype=np.int64),
+        len(dst_rows),
+        np.ascontiguousarray(rowmap, dtype=np.int32),
+        int(old_rows.shape[1]), int(kmin), int(new_rows.shape[1]),
+        new_rows.reshape(-1), new_valid.view(np.uint8).reshape(-1),
+        new_offset.reshape(-1), new_len.reshape(-1), new_slot.reshape(-1),
+    )
+    return True
 
 
 def native_fill_tables(

@@ -399,4 +399,36 @@ void hood_fill_tables(
     }
 }
 
+// Incremental-epoch table patch (one device's hood): copy every reused
+// row src_rows[i] -> dst_rows[i] across all five gather tables in a
+// single fused sweep, pushing nbr_rows values through the old-row ->
+// new-row map.  Old tables are [R_old, Kold(,3)], new tables
+// [R_new, Kmax(,3)] pre-filled with their pad values; only the first
+// Kmin columns can carry data for a reused row.
+void delta_patch_tables(
+    const int32_t* o_rows, const uint8_t* o_valid, const int32_t* o_off,
+    const int32_t* o_len, const int32_t* o_slot,
+    const int64_t* dst_rows, const int64_t* src_rows,
+    const int64_t* row_counts, int64_t n_reuse,
+    const int32_t* rowmap,
+    int64_t Kold, int64_t Kmin, int64_t Kmax,
+    int32_t* n_rows, uint8_t* n_valid, int32_t* n_off, int32_t* n_len,
+    int32_t* n_slot
+) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n_reuse; i++) {
+        const int64_t sb = src_rows[i] * Kold;
+        const int64_t db = dst_rows[i] * Kmax;
+        const int64_t k_row =
+            row_counts[i] < Kmin ? row_counts[i] : Kmin;
+        for (int64_t k = 0; k < k_row; k++) {
+            n_rows[db + k] = rowmap[o_rows[sb + k]];
+        }
+        memcpy(n_valid + db, o_valid + sb, size_t(k_row));
+        memcpy(n_off + 3 * db, o_off + 3 * sb, size_t(3 * k_row) * 4);
+        memcpy(n_len + db, o_len + sb, size_t(k_row) * 4);
+        memcpy(n_slot + db, o_slot + sb, size_t(k_row) * 4);
+    }
+}
+
 }  // extern "C"

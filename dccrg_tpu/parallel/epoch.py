@@ -103,8 +103,18 @@ def _build_hood(
     offsets: np.ndarray,
     n_devices: int,
 ):
-    N = len(leaves)
     lists = find_all_neighbors(mapping, topology, leaves, offsets)
+    to_start, to_src, pairs, is_outer = _invert_and_pairs(
+        lists, leaves, n_devices
+    )
+    return lists, to_start, to_src, pairs, is_outer
+
+
+def _invert_and_pairs(lists: NeighborLists, leaves: LeafSet, n_devices: int):
+    """(inverse CSR, ghost pairs, inner/outer flags) for a neighbor-list
+    set — the owner-dependent tail of a hood build, shared by the full
+    build and the incremental delta path (``epoch_delta.py``)."""
+    N = len(leaves)
     owner = leaves.owner.astype(np.int64)
 
     # Fused native pass: inverse CSR + ghost pairs + inner/outer in one
@@ -114,8 +124,7 @@ def _build_hood(
     native = native_invert_and_pairs(lists.start, lists.nbr_pos, owner,
                                      n_devices)
     if native is not None:
-        to_start, to_src, pairs, is_outer = native
-        return lists, to_start, to_src, pairs, is_outer
+        return native
 
     # --- numpy fallback (semantic source of truth)
     to_start, to_src = invert_neighbors(N, lists)
@@ -141,7 +150,7 @@ def _build_hood(
     rem = np.flatnonzero(mask)
     is_outer[src_of[rem]] = True
     is_outer[lists.nbr_pos[rem]] = True
-    return lists, to_start, to_src, pairs, is_outer
+    return to_start, to_src, pairs, is_outer
 
 
 def build_epoch(
@@ -228,6 +237,38 @@ def _build_epoch_impl(
         pairs = np.zeros((0, 2), dtype=np.int64)
 
     # --- row layout
+    epoch, len_all = _row_layout(mapping, topology, leaves, D, pairs)
+
+    # --- pass 2: per-hood device tables + schedules
+    for hid, (offsets, lists, to_start, to_src, h_pairs, is_outer) in (
+        hood_raw.items()
+    ):
+        epoch.hoods[hid] = _finish_hood(
+            epoch, offsets, lists, to_start, to_src, h_pairs, len_all,
+            is_outer,
+        )
+    epoch.dense = (
+        detect_dense(mapping, topology, leaves, D)
+        if uniform_geometry else None
+    )
+    return epoch
+
+
+def _row_layout(
+    mapping: Mapping,
+    topology: Topology,
+    leaves: LeafSet,
+    n_devices: int,
+    pairs: np.ndarray,
+) -> tuple[Epoch, np.ndarray]:
+    """Row layout + per-row cell tables for a (leaves, ghost pairs)
+    snapshot: the hood-independent part of an epoch, shared by the full
+    build and the incremental delta path.  Returns ``(epoch, len_all)``
+    with ``epoch.hoods`` still empty."""
+    N = len(leaves)
+    D = n_devices
+    owner = leaves.owner.astype(np.int64)
+
     local_pos = [np.flatnonzero(owner == d) for d in range(D)]
     ghost_pos = [np.sort(pairs[pairs[:, 0] == d, 1]) for d in range(D)]
     n_local = np.array([len(p) for p in local_pos], dtype=np.int64)
@@ -269,39 +310,15 @@ def _build_epoch_impl(
         cell_ids=cell_ids,
         local_mask=local_mask,
     )
-
-    # --- pass 2: per-hood device tables + schedules
-    for hid, (offsets, lists, to_start, to_src, h_pairs, is_outer) in (
-        hood_raw.items()
-    ):
-        epoch.hoods[hid] = _finish_hood(
-            epoch, offsets, lists, to_start, to_src, h_pairs, len_all,
-            is_outer,
-        )
-    epoch.dense = (
-        detect_dense(mapping, topology, leaves, D)
-        if uniform_geometry else None
-    )
-    return epoch
+    return epoch, len_all
 
 
-def _finish_hood(
-    epoch: Epoch,
-    offsets: np.ndarray,
-    lists: NeighborLists,
-    to_start: np.ndarray,
-    to_src: np.ndarray,
-    pairs: np.ndarray,
-    len_all: np.ndarray,
-    is_outer: np.ndarray,
-) -> HoodState:
-    D, R, N = epoch.n_devices, epoch.R, len(epoch.leaves)
+def _hood_schedule(epoch: Epoch, pairs: np.ndarray):
+    """Pairwise-aligned send/recv row schedule for a hood's ghost pairs
+    (reference's sorted send/recv lists, ``dccrg.hpp:8590-8752``)."""
+    D, N = epoch.n_devices, len(epoch.leaves)
+    scratch = epoch.R - 1
     owner = epoch.leaves.owner.astype(np.int64)
-    scratch = R - 1
-
-    # --- halo schedule: for each (receiver j, sender i) the cells are the
-    # hood's ghost pairs; order by cell id (= by position) like the
-    # reference's sorted send/recv lists (dccrg.hpp:8590-8752)
     recv_d = pairs[:, 0]
     gpos = pairs[:, 1]
     send_d = owner[gpos]
@@ -331,6 +348,38 @@ def _finish_hood(
             if m.any():
                 rrow[m] = epoch.rows_on_device(d, gp[m])
         recv_rows[rd, sd, in_grp] = rrow
+    return send_rows, recv_rows, pair_counts
+
+
+def _hood_masks(epoch: Epoch, is_outer: np.ndarray):
+    """Inner/outer iteration masks (dccrg.hpp:7478-7519): outer = local
+    cell with a remote cell among neighbors_of or neighbors_to."""
+    D, R = epoch.n_devices, epoch.R
+    inner_mask = np.zeros((D, R), dtype=bool)
+    outer_mask = np.zeros((D, R), dtype=bool)
+    for d in range(D):
+        lp = epoch.local_pos[d]
+        rows = np.arange(len(lp))
+        inner_mask[d, rows] = ~is_outer[lp]
+        outer_mask[d, rows] = is_outer[lp]
+    return inner_mask, outer_mask
+
+
+def _finish_hood(
+    epoch: Epoch,
+    offsets: np.ndarray,
+    lists: NeighborLists,
+    to_start: np.ndarray,
+    to_src: np.ndarray,
+    pairs: np.ndarray,
+    len_all: np.ndarray,
+    is_outer: np.ndarray,
+) -> HoodState:
+    D, R, N = epoch.n_devices, epoch.R, len(epoch.leaves)
+    owner = epoch.leaves.owner.astype(np.int64)
+    scratch = R - 1
+
+    send_rows, recv_rows, pair_counts = _hood_schedule(epoch, pairs)
 
     # --- neighbor gather tables over local rows
     counts = np.diff(lists.start)
@@ -377,16 +426,8 @@ def _finish_hood(
             nbr_offset.reshape(-1, 3)[flat] = lists.offset
             nbr_len.reshape(-1)[flat] = len_all[lists.nbr_pos]
             nbr_slot.reshape(-1)[flat] = lists.slot
-    # inner/outer split (dccrg.hpp:7478-7519): outer = local cell with a
-    # remote cell among neighbors_of or neighbors_to; computed alongside
-    # the ghost pairs in _build_hood
-    inner_mask = np.zeros((D, R), dtype=bool)
-    outer_mask = np.zeros((D, R), dtype=bool)
-    for d in range(D):
-        lp = epoch.local_pos[d]
-        rows = np.arange(len(lp))
-        inner_mask[d, rows] = ~is_outer[lp]
-        outer_mask[d, rows] = is_outer[lp]
+    # inner/outer split computed alongside the ghost pairs in _build_hood
+    inner_mask, outer_mask = _hood_masks(epoch, is_outer)
 
     return HoodState(
         offsets=offsets,

@@ -11,17 +11,75 @@ debugging sessions; it is pure host-side numpy.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 from .collectives import fetch
 
-__all__ = ["verify_grid", "verify_user_data"]
+__all__ = ["verify_grid", "verify_user_data", "compare_epochs"]
+
+
+def compare_epochs(got, want) -> None:
+    """Assert two epochs carry bit-identical derived state, table by
+    table — the incremental rebuild's oracle check (``got`` from
+    ``parallel/epoch_delta.py``, ``want`` a fresh ``build_epoch``).
+    Raises AssertionError naming the first differing table."""
+    assert got.n_devices == want.n_devices
+    assert got.R == want.R, (got.R, want.R)
+    np.testing.assert_array_equal(got.leaves.cells, want.leaves.cells)
+    np.testing.assert_array_equal(got.leaves.owner, want.leaves.owner)
+    for name in ("n_local", "n_ghost", "row_of", "cell_len", "cell_level",
+                 "cell_ids", "local_mask"):
+        np.testing.assert_array_equal(
+            getattr(got, name), getattr(want, name), err_msg=f"epoch.{name}"
+        )
+    for d in range(got.n_devices):
+        np.testing.assert_array_equal(
+            got.local_pos[d], want.local_pos[d], err_msg=f"local_pos[{d}]"
+        )
+        np.testing.assert_array_equal(
+            got.ghost_pos[d], want.ghost_pos[d], err_msg=f"ghost_pos[{d}]"
+        )
+    assert (got.dense is None) == (want.dense is None), "dense flag"
+    assert set(got.hoods) == set(want.hoods), "hood ids"
+    for hid in want.hoods:
+        g, w = got.hoods[hid], want.hoods[hid]
+        np.testing.assert_array_equal(
+            g.offsets, w.offsets, err_msg=f"hood {hid}: offsets"
+        )
+        for name in ("to_start", "to_src", "send_rows", "recv_rows",
+                     "pair_counts", "inner_mask", "outer_mask", "nbr_rows",
+                     "nbr_valid", "nbr_offset", "nbr_len", "nbr_slot"):
+            np.testing.assert_array_equal(
+                getattr(g, name), getattr(w, name),
+                err_msg=f"hood {hid}: {name}",
+            )
+        for name in ("start", "nbr_pos", "nbr_cell", "offset", "slot"):
+            np.testing.assert_array_equal(
+                getattr(g.lists, name), getattr(w.lists, name),
+                err_msg=f"hood {hid}: lists.{name}",
+            )
 
 
 def verify_grid(grid, check_two_to_one: bool = True) -> None:
-    """Raise AssertionError on any internal inconsistency."""
+    """Raise AssertionError on any internal inconsistency.
+
+    With ``DCCRG_EPOCH_VERIFY=1`` additionally rebuilds the epoch from
+    scratch and asserts the live one (possibly delta-patched after
+    AMR/LB) matches it table for table — the incremental-rebuild oracle
+    run at every verification point."""
     leaves = grid.leaves
     epoch = grid.epoch
     N = len(leaves)
+
+    if os.environ.get("DCCRG_EPOCH_VERIFY", "0") != "0":
+        from ..parallel.epoch import build_epoch
+
+        compare_epochs(epoch, build_epoch(
+            grid.mapping, grid.topology, leaves, grid.n_devices,
+            grid.neighborhoods,
+            uniform_geometry=grid._uniform_geometry(),
+        ))
 
     # --- directory invariants (is_consistent)
     assert (np.diff(leaves.cells) > 0).all(), "leaf ids not sorted/unique"

@@ -1,0 +1,246 @@
+"""Incremental epoch rebuild (``parallel/epoch_delta.py``).
+
+The contract under test is the strongest one available: after every
+AMR commit / repartition in a randomized churn sequence, the live
+(delta-patched) epoch must be **table-for-table identical** to a fresh
+``build_epoch`` of the same (leaves, owner) snapshot — on 1- and
+8-device meshes, with user neighborhoods registered mid-sequence, on
+both the native and the pure-numpy paths.  Plus: the fast path must
+actually engage (``epoch.delta_builds > 0``), and every documented
+fallback reason must be triggerable.
+"""
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.parallel.epoch import build_epoch
+from dccrg_tpu.parallel.epoch_delta import (
+    FALLBACK_REASONS,
+    build_epoch_delta,
+)
+from dccrg_tpu.utils.verify import compare_epochs, verify_grid
+
+
+def make_grid(n=8, max_lvl=2, n_dev=8, method="RCB", hood=1,
+              periodic=(True, False, True)):
+    return (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(hood)
+        .set_periodic(*periodic)
+        .set_maximum_refinement_level(max_lvl)
+        .set_load_balancing_method(method)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / n,) * 3,
+        )
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+
+
+def oracle(g):
+    return build_epoch(
+        g.mapping, g.topology, g.leaves, g.n_devices, g.neighborhoods,
+        uniform_geometry=g._uniform_geometry(),
+    )
+
+
+def churn_step(g, rng, round_i):
+    """One randomized mutation: AMR request storm + commit, then a
+    repartition every other round (pins shuffle ownership so the LB
+    delta path sees real migrations)."""
+    ids = g.get_cells()
+    for cid in rng.choice(ids, size=min(10, len(ids)), replace=False):
+        op = rng.integers(4)
+        if op == 0:
+            g.refine_completely(int(cid))
+        elif op == 1:
+            g.unrefine_completely(int(cid))
+        elif op == 2:
+            g.dont_refine(int(cid))
+        else:
+            g.dont_unrefine(int(cid))
+    before = set(g.get_cells().tolist())
+    g.stop_refining()
+    after = set(g.get_cells().tolist())
+    # the exposed AMR touched set is exactly the leaf-set symmetric diff
+    delta = g.get_last_adaptation_delta()
+    assert set(delta.added.tolist()) == after - before
+    assert set(delta.removed.tolist()) == before - after
+    yield "amr"
+    if round_i % 2 == 1:
+        for cid in rng.choice(g.get_cells(), size=5, replace=False):
+            g.pin(int(cid), int(rng.integers(g.n_devices)))
+        g.balance_load()
+        g.unpin_all_cells()
+        yield "lb"
+
+
+@pytest.mark.parametrize("n_dev,seed", [(1, 0), (8, 1), (8, 5)])
+def test_churn_identical_to_full_build(n_dev, seed):
+    rng = np.random.default_rng(seed)
+    g = make_grid(n_dev=n_dev)
+    for round_i in range(6):
+        if round_i == 3:
+            # a user neighborhood mid-sequence: its registration is a
+            # full rebuild, every later commit patches BOTH hoods
+            assert g.add_neighborhood(7, [(1, 0, 0), (0, -1, 0)])
+        for _ in churn_step(g, rng, round_i):
+            compare_epochs(g.epoch, oracle(g))
+            verify_grid(g)
+    assert (obs.metrics.counter_value("epoch.delta_builds") or 0) > 0
+
+
+def test_numpy_path_identical_to_full_build(monkeypatch):
+    """The pure-numpy delta (CSR splice + inverse patch + run-copy table
+    patch) against the pure-numpy full build."""
+    import dccrg_tpu.native as native
+
+    monkeypatch.setattr(native, "native_find_neighbors",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(native, "native_invert_and_pairs",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(native, "native_sort_unique_u64",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(native, "native_fill_tables",
+                        lambda *a, **k: False)
+    monkeypatch.setattr(native, "native_delta_patch_tables",
+                        lambda *a, **k: False)
+    rng = np.random.default_rng(2)
+    g = make_grid(n_dev=8)
+    for round_i in range(4):
+        for _ in churn_step(g, rng, round_i):
+            compare_epochs(g.epoch, oracle(g))
+            verify_grid(g)
+
+
+def test_delta_fast_path_engages():
+    """A small clustered storm on a refined grid must take the delta
+    path (the counter moves and the phase records a span)."""
+    g = make_grid(n_dev=8)
+    ids = g.get_cells()
+    ctr = g.geometry.get_center(ids)
+    r = np.linalg.norm(ctr - 0.5, axis=1)
+    g.refine_completely_many(ids[r < 0.3])
+    g.stop_refining()  # large change: may fall back, not asserted
+    before = obs.metrics.counter_value("epoch.delta_builds") or 0
+    phase_before = (obs.metrics.report()["phases"]
+                    .get("epoch.delta_build", {}).get("count", 0))
+    g.refine_completely(int(g.get_cells()[0]))
+    g.stop_refining()
+    assert (obs.metrics.counter_value("epoch.delta_builds") or 0) > before
+    assert (obs.metrics.report()["phases"]["epoch.delta_build"]["count"]
+            > phase_before)
+    compare_epochs(g.epoch, oracle(g))
+
+
+def _fallbacks(reason):
+    return obs.metrics.counter_value(
+        "epoch.delta_fallbacks", reason=reason
+    ) or 0
+
+
+def test_fallback_fraction():
+    g = make_grid(n_dev=8, max_lvl=1)
+    g.refine_completely(1)
+    g.stop_refining()  # leave the dense-eligible uniform grid first
+    before = _fallbacks("fraction")
+    g.refine_completely_many(g.get_cells())  # touches everything
+    g.stop_refining()
+    assert _fallbacks("fraction") > before
+    compare_epochs(g.epoch, oracle(g))
+
+
+def test_fallback_r_growth(monkeypatch):
+    monkeypatch.setenv("DCCRG_EPOCH_DELTA_MAX_R_GROWTH", "1.0")
+    g = make_grid(n_dev=8)
+    g.refine_completely(1)
+    g.stop_refining()
+    before = _fallbacks("r_growth")
+    # a tiny storm: closure is small, but R must grow on the refined
+    # device -> with growth capped at 1.0x the delta path must decline
+    g.refine_completely(int(g.get_cells()[10]))
+    g.stop_refining()
+    assert _fallbacks("r_growth") > before
+    compare_epochs(g.epoch, oracle(g))
+
+
+def test_fallback_dense_flip():
+    g = make_grid(n_dev=8, max_lvl=1)
+    assert g.epoch.dense is not None  # uniform level-0 block partition
+    before = _fallbacks("dense_flip")
+    g.refine_completely(1)
+    g.stop_refining()
+    assert _fallbacks("dense_flip") > before
+    assert g.epoch.dense is None
+    compare_epochs(g.epoch, oracle(g))
+
+
+def test_fallback_device_count_and_hoods_changed():
+    g = make_grid(n_dev=8)
+    g.refine_completely(1)
+    g.stop_refining()
+    before = _fallbacks("device_count")
+    assert build_epoch_delta(
+        g.epoch, g.leaves, g.n_devices + 1, g.neighborhoods,
+        uniform_geometry=g._uniform_geometry(),
+    ) is None
+    assert _fallbacks("device_count") > before
+    before = _fallbacks("hoods_changed")
+    hoods = dict(g.neighborhoods)
+    hoods[3] = np.array([[1, 0, 0]], dtype=np.int64)
+    assert build_epoch_delta(
+        g.epoch, g.leaves, g.n_devices, hoods,
+        uniform_geometry=g._uniform_geometry(),
+    ) is None
+    assert _fallbacks("hoods_changed") > before
+    assert set(FALLBACK_REASONS) >= {
+        "fraction", "r_growth", "dense_flip", "device_count",
+        "hoods_changed",
+    }
+
+
+def test_delta_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("DCCRG_EPOCH_DELTA", "0")
+    g = make_grid(n_dev=1)
+    g.refine_completely(1)
+    g.stop_refining()
+    assert build_epoch_delta(
+        g.epoch, g.leaves, g.n_devices, g.neighborhoods,
+        uniform_geometry=g._uniform_geometry(),
+    ) is None
+    compare_epochs(g.epoch, oracle(g))
+
+
+def test_epoch_verify_env_cross_checks(monkeypatch):
+    """DCCRG_EPOCH_VERIFY=1: every incremental epoch self-checks against
+    a fresh full build (and verify_grid re-checks it)."""
+    monkeypatch.setenv("DCCRG_EPOCH_VERIFY", "1")
+    rng = np.random.default_rng(3)
+    g = make_grid(n_dev=8)
+    for round_i in range(3):
+        for _ in churn_step(g, rng, round_i):
+            verify_grid(g)
+
+
+def test_prev_epoch_is_slim_and_releasable():
+    """After a structural change only the slim carry is retained (no
+    hood tables), remap_state stays repeatable for several payloads, and
+    release_prev_epoch drops the carry."""
+    g = make_grid(n_dev=8)
+    s1 = g.new_state({"a": ((), np.float64)}, fill=1.0)
+    s2 = g.new_state({"b": ((), np.float32)}, fill=2.0)
+    g.refine_completely(1)
+    g.stop_refining()
+    carry = g._prev_epoch
+    assert carry is not None and not hasattr(carry, "hoods")
+    assert not hasattr(carry, "cell_ids")  # row tables not retained
+    s1 = g.remap_state(s1)
+    s2 = g.remap_state(s2)  # second payload still remaps
+    ids = g.get_cells()
+    assert np.allclose(g.get_cell_data(s1, "a", ids), 1.0)
+    assert np.allclose(g.get_cell_data(s2, "b", ids), 2.0)
+    g.release_prev_epoch()
+    assert g._prev_epoch is None
+    assert g.remap_state(s1) is s1  # identity until the next change

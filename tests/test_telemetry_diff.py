@@ -145,20 +145,21 @@ def test_cli_verdict_and_exit_codes(diff, tmp_path):
                               "epoch.build": 0.020,
                               "amr.refine": 0.005})}))
     out = tmp_path / "verdict.json"
+    hist = ["--history", str(tmp_path / "history.jsonl")]
     assert diff.main(["--current", str(cur_pass), "--baseline", str(base_f),
-                      "--json", str(out)]) == 0
+                      "--json", str(out)] + hist) == 0
     assert json.loads(out.read_text())["verdict"] == "PASS"
     assert diff.main(["--current", str(cur_fail), "--baseline", str(base_f),
-                      "--json", str(out)]) == 1
+                      "--json", str(out)] + hist) == 1
     rec = json.loads(out.read_text())
     assert rec["verdict"] == "FAIL"
     assert any("halo.exchange" in f for f in rec["failures"])
     # the allowlist flag flips it back to PASS
     assert diff.main(["--current", str(cur_fail), "--baseline", str(base_f),
-                      "--allow", "halo.exchange"]) == 0
+                      "--allow", "halo.exchange"] + hist) == 0
     # unreadable input is a distinct exit code (2), not a crash
     assert diff.main(["--current", str(tmp_path / "absent.json"),
-                      "--baseline", str(base_f)]) == 2
+                      "--baseline", str(base_f)] + hist) == 2
 
 
 def test_gate_on_repo_telemetry_round_trip(diff, tmp_path):
@@ -167,4 +168,61 @@ def test_gate_on_repo_telemetry_round_trip(diff, tmp_path):
     tel = os.path.join(ROOT, "telemetry.json")
     if not os.path.exists(tel):
         pytest.skip("no telemetry.json in repo root")
-    assert diff.main(["--current", tel, "--baseline", tel]) == 0
+    assert diff.main(["--current", tel, "--baseline", tel,
+                      "--no-history"]) == 0
+
+
+# ------------------------------------------------- history + drift gate
+
+
+def test_drift_gate_catches_slow_creep(diff):
+    """+12% per round stays inside a 35% step threshold forever; the
+    cumulative check against the oldest retained round fails it."""
+    rounds = [_phases(**{"epoch.delta_build": 0.010 * (1.12 ** i),
+                         "halo.exchange": 0.010})
+              for i in range(8)]
+    # every consecutive pair passes the step gate
+    for a, b in zip(rounds, rounds[1:]):
+        assert diff.compare(b, a, threshold=0.35)["verdict"] == "PASS"
+    v = diff.check_drift(rounds[-1], rounds[0], threshold=0.75)
+    assert v["verdict"] == "FAIL"
+    assert any("epoch.delta_build" in f and "drift" in f
+               for f in v["failures"])
+    statuses = {r["phase"]: r["status"] for r in v["rows"]}
+    assert statuses["epoch.delta_build"] == "DRIFT"
+    assert statuses["halo.exchange"] == "ok"
+    # a missing phase is the step gate's business, not drift's
+    v2 = diff.check_drift(_phases(**{"halo.exchange": 0.010}), rounds[0])
+    assert v2["verdict"] == "PASS"
+
+
+def test_history_file_rolls_and_feeds_drift(diff, tmp_path):
+    hist = tmp_path / "history.jsonl"
+    base_f = tmp_path / "base.json"
+    base_f.write_text(json.dumps({"phases": BASE}))
+    # 12 rounds with slow creep in one phase; keep window of 5
+    for i in range(12):
+        cur = tmp_path / f"cur{i}.json"
+        cur.write_text(json.dumps({"phases": _phases(**{
+            "halo.exchange": 0.010,
+            "epoch.build": 0.020 * (1.10 ** i),
+            "amr.refine": 0.005,
+        })}))
+        rc = diff.main(["--current", str(cur), "--baseline", str(base_f),
+                        "--history", str(hist), "--history-keep", "5",
+                        "--allow", "epoch.build"])
+        assert rc == 0  # creeping phase allowlisted: gate stays green
+    history = diff.load_history(str(hist))
+    assert len(history) == 5  # rolled to the retained window
+    assert history[-1]["source"].endswith("cur11.json")
+    # without the allowlist the drift over the window (1.1^4 = 1.46x
+    # at default 1.75x) still passes, but a steeper creep fails
+    steep = tmp_path / "steep.json"
+    steep.write_text(json.dumps({"phases": _phases(**{
+        "halo.exchange": 0.010,
+        "epoch.build": 0.200,
+        "amr.refine": 0.005,
+    })}))
+    rc = diff.main(["--current", str(steep), "--baseline", str(steep),
+                    "--history", str(hist)])
+    assert rc == 1  # cumulative drift vs oldest retained round

@@ -40,10 +40,12 @@ import time
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-#: the phase set the acceptance criteria require (ISSUE 1)
+#: the phase set the acceptance criteria require (ISSUE 1; ISSUE 3 adds
+#: the incremental rebuild phase)
 REQUIRED_PHASES = (
     "halo.exchange",
     "epoch.build",
+    "epoch.delta_build",
     "loadbalance.migrate",
     "amr.refine",
     "checkpoint.write",
@@ -55,6 +57,9 @@ REQUIRED_NONZERO_COUNTERS = (
     "halo.cells_moved",
     "amr.cells_refined",
     "checkpoint.bytes_written",
+    # the probe's small second commit must take the incremental path,
+    # not fall back — a silent fallback is a coverage loss
+    "epoch.delta_builds",
 )
 
 
@@ -229,6 +234,11 @@ def build_workload():
         g.refine_completely(int(cid))
     g.stop_refining()
     g.balance_load()
+    # one small follow-up commit: its closure is a few percent of the
+    # grid, so derived state is delta-patched (epoch.delta_build), not
+    # rebuilt — the probe covers BOTH rebuild paths
+    g.refine_completely(int(g.get_cells()[0]))
+    g.stop_refining()
     adv = Advection(g, dtype=np.float32, allow_dense=False)
     state = adv.initialize_state()
     dt = np.float32(0.4 * adv.max_time_step(state))

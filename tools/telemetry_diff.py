@@ -26,6 +26,13 @@ total is below ``--min-total`` are skipped as noise (a 50-microsecond
 phase doubling is jitter, not a regression).  A phase present in the
 baseline but MISSING from the current round is a coverage loss and
 fails the gate (unless allowlisted); new phases only inform.
+
+History + drift: every run appends its phase table to
+``tools/telemetry_history.jsonl`` (last ``--history-keep`` rounds
+retained) and ALSO gates the current round against the OLDEST retained
+round with ``--drift-threshold`` — a phase creeping a few percent per
+round never trips the step gate but doubles over the window; the drift
+gate catches exactly that.  ``--no-history`` disables both.
 """
 from __future__ import annotations
 
@@ -39,11 +46,13 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 #: the hot-seam phases the gate watches by default (halo / epoch / the
-#: in-loop step seams ISSUE 2 names); --phases overrides
+#: in-loop step seams ISSUE 2 names, plus ISSUE 3's incremental
+#: rebuild); --phases overrides
 DEFAULT_PHASES = (
     "halo.exchange",
     "epoch.build",
     "epoch.hood_build",
+    "epoch.delta_build",
     "loadbalance.migrate",
     "amr.refine",
     "checkpoint.write",
@@ -166,6 +175,76 @@ def compare(current: dict, baseline: dict, threshold: float = 0.35,
     }
 
 
+def load_history(path: str) -> list:
+    """The retained rounds from a phase-history JSONL, oldest first.
+    Unparseable or phase-less lines are skipped (a killed writer leaves
+    earlier complete lines intact)."""
+    out = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and isinstance(
+                    rec.get("phases"), dict
+                ):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def append_history(path: str, phases: dict, keep: int,
+                   source: str = "") -> None:
+    """Append this round's phase table and trim to the last ``keep``
+    rounds (atomic rewrite)."""
+    history = load_history(path)
+    history.append({"source": source, "phases": phases})
+    history = history[-max(keep, 1):]
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for rec in history:
+            f.write(json.dumps(rec) + "\n")
+    os.replace(tmp, path)
+
+
+def check_drift(current: dict, oldest: dict, threshold: float = 0.75,
+                phases=None, allow=(), min_total: float = 1e-3) -> dict:
+    """Cumulative-drift gate: the same mean-per-span comparison as
+    :func:`compare`, but against the OLDEST retained round — a phase
+    creeping +10% every round stays inside the step threshold forever
+    yet doubles over the window; this catches it.  Coverage loss is the
+    step gate's job, so a phase missing from the current round does not
+    fail here."""
+    v = compare(current, oldest, threshold=threshold, phases=phases,
+                allow=allow, min_total=min_total)
+    failures = []
+    for row in v["rows"]:
+        if row["status"] == "REGRESSED":
+            row["status"] = "DRIFT"
+            failures.append(
+                f"{row['phase']}: cumulative drift "
+                f"{row['base_mean_s']:.6f}s -> {row['cur_mean_s']:.6f}s "
+                f"({row['ratio']:.2f}x over the retained window, "
+                f"threshold {1 + threshold:.2f}x)"
+            )
+        elif row["status"] == "allowed-regression":
+            row["status"] = "allowed-drift"
+        elif row["status"] == "MISSING":
+            row["status"] = "ungated"
+    return {
+        "verdict": "FAIL" if failures else "PASS",
+        "threshold": threshold,
+        "failures": failures,
+        "rows": v["rows"],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -186,6 +265,18 @@ def main(argv=None) -> int:
                          "comma-separated)")
     ap.add_argument("--json", default=None,
                     help="also write the verdict record to this path")
+    ap.add_argument("--history",
+                    default=str(ROOT / "tools" / "telemetry_history.jsonl"),
+                    help="phase-history JSONL: each run appends its "
+                         "phase table and drift-checks against the "
+                         "oldest retained round")
+    ap.add_argument("--no-history", action="store_true",
+                    help="neither append to nor drift-check the history")
+    ap.add_argument("--history-keep", type=int, default=10,
+                    help="rounds retained in the history window")
+    ap.add_argument("--drift-threshold", type=float, default=0.75,
+                    help="max allowed fractional mean-time drift vs the "
+                         "oldest retained round")
     args = ap.parse_args(argv)
 
     baseline_path = args.baseline or discover_baseline()
@@ -206,6 +297,28 @@ def main(argv=None) -> int:
     verdict["current"] = str(args.current)
     verdict["baseline"] = str(baseline_path)
 
+    # cumulative-drift gate over the retained history window (the
+    # round-over-round step gate above cannot see slow creep)
+    hist_path = None if args.no_history else args.history
+    if hist_path:
+        history = load_history(hist_path)
+        if len(history) >= 2:
+            drift = check_drift(
+                current, history[0]["phases"],
+                threshold=args.drift_threshold, phases=phases,
+                allow=allow, min_total=args.min_total,
+            )
+            drift["baseline_source"] = history[0].get("source", "")
+            drift["rounds_spanned"] = len(history)
+            verdict["drift"] = drift
+            verdict["failures"] = (
+                list(verdict["failures"]) + list(drift["failures"])
+            )
+            if drift["verdict"] == "FAIL":
+                verdict["verdict"] = "FAIL"
+        append_history(hist_path, current, args.history_keep,
+                       source=str(args.current))
+
     for row in verdict["rows"]:
         parts = [f"{row['phase']:24s} {row['status']:>18s}"]
         if "base_mean_s" in row and "cur_mean_s" in row:
@@ -214,6 +327,11 @@ def main(argv=None) -> int:
             if "ratio" in row:
                 parts.append(f"({row['ratio']:.2f}x)")
         print("  ".join(parts))
+    if "drift" in verdict:
+        d = verdict["drift"]
+        print(f"telemetry_diff: drift {d['verdict']} vs oldest of "
+              f"{d['rounds_spanned']} retained rounds "
+              f"(threshold {1 + d['threshold']:.2f}x)")
     print(f"telemetry_diff: {verdict['verdict']} "
           f"({args.current} vs {baseline_path}, "
           f"threshold {1 + args.threshold:.2f}x)")
