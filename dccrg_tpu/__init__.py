@@ -6,6 +6,7 @@ payloads in HBM, halo exchanges as XLA collectives over ICI, host-side
 replicated grid/AMR metadata, and native load balancing in place of Zoltan.
 """
 from . import obs
+from . import resilience
 from .core.mapping import ERROR_CELL, ERROR_INDEX, Mapping
 from .core.topology import Topology
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
@@ -24,6 +25,7 @@ __all__ = [
     "Grid",
     "make_mesh",
     "obs",
+    "resilience",
 ]
 
 __version__ = "0.1.0"

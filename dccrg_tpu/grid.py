@@ -1567,34 +1567,72 @@ class Grid:
     # ------------------------------------------------------------------- IO
 
     def save_grid_data(self, state, path: str, spec, user_header: bytes = b"",
-                       ragged=None):
+                       ragged=None, version: int | None = None):
         """Checkpoint grid structure + payloads (reference
         ``save_grid_data``, ``dccrg.hpp:1089-1716``).  ``ragged`` maps a
         variable-size field to its count field: only ``count[i]`` rows are
-        written per cell."""
+        written per cell.  ``version=1`` writes the legacy CRC-less
+        layout (default: the hardened v2 format)."""
+        from .io.checkpoint import CHECKPOINT_VERSION
         from .io.checkpoint import save_grid_data as _save
 
-        _save(self, state, path, spec, user_header, ragged=ragged)
+        _save(self, state, path, spec, user_header, ragged=ragged,
+              version=CHECKPOINT_VERSION if version is None else version)
 
     @staticmethod
-    def load_grid_data(path: str, spec, mesh=None, n_devices=None, ragged=None):
+    def load_grid_data(path: str, spec, mesh=None, n_devices=None, ragged=None,
+                       on_error: str = "raise"):
         """Recreate a saved grid on the current devices; any device count
         works (reference ``load_grid_data``, ``dccrg.hpp:1742-2404``).
-        Returns (grid, state, user_header)."""
+        Returns (grid, state, user_header); a torn or corrupt file raises
+        :class:`~dccrg_tpu.io.checkpoint.CheckpointError` naming the
+        failing section.  ``on_error="salvage"`` instead recovers every
+        intact cell and returns ``(grid, state, user_header,
+        lost_cells)``."""
         from .io.checkpoint import load_grid_data as _load
 
-        return _load(path, spec, ragged=ragged, mesh=mesh, n_devices=n_devices)
+        return _load(path, spec, ragged=ragged, mesh=mesh,
+                     n_devices=n_devices, on_error=on_error)
 
     @staticmethod
     def start_loading_grid_data(path: str, spec, mesh=None, n_devices=None,
-                                ragged=None):
+                                ragged=None, on_error: str = "raise"):
         """Chunked load: returns a loader; call
         ``loader.continue_loading_grid_data(max_cells)`` until it returns
         False, then ``loader.finish_loading_grid_data()`` (reference
         ``dccrg.hpp:1742-2404``)."""
         from .io.checkpoint import start_loading_grid_data as _start
 
-        return _start(path, spec, ragged=ragged, mesh=mesh, n_devices=n_devices)
+        return _start(path, spec, ragged=ragged, mesh=mesh,
+                      n_devices=n_devices, on_error=on_error)
+
+    def save_checkpoint(self, state, directory: str, spec, keep: int = 3,
+                        user_header: bytes = b"", ragged=None) -> int:
+        """Commit one generation into a crash-safe checkpoint lineage
+        (``resilience/manager.py``): fsync'd atomic write, checksummed
+        MANIFEST, oldest generations beyond ``keep`` rotated out.
+        Returns the committed generation number."""
+        from .resilience.manager import CheckpointLineage
+
+        return CheckpointLineage(directory, keep=keep).commit(
+            self, state, spec, user_header=user_header, ragged=ragged
+        )
+
+    @staticmethod
+    def resume_latest(directory: str, spec, mesh=None, n_devices=None,
+                      ragged=None, verify: bool = True):
+        """Resume from the newest VALID generation in a lineage
+        directory, scanning back past torn/corrupt ones and re-verifying
+        the restored grid with ``utils.verify.verify_grid``.  Returns
+        ``(grid, state, user_header, generation)``; raises
+        :class:`~dccrg_tpu.io.checkpoint.CheckpointError` when nothing
+        in the lineage is recoverable."""
+        from .resilience.manager import CheckpointLineage
+
+        return CheckpointLineage(directory).latest_valid(
+            spec, mesh=mesh, n_devices=n_devices, ragged=ragged,
+            verify=verify,
+        )
 
     def write_vtk_file(self, path: str, scalars: dict | None = None,
                        binary: bool = True):

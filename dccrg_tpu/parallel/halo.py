@@ -48,6 +48,38 @@ def _flush_record_cache(cache: dict) -> None:
             _metrics.inc_batch([(key, v * n) for key, v in pairs])
 
 
+def _maybe_nan_storm(state):
+    """Fault-injection seam: when the ``halo.nan`` site is armed and
+    fires, poison a few random rows of every floating field with NaN
+    *before* the exchange, so the storm propagates into ghost copies
+    exactly the way a corrupted payload would (``resilience/inject``).
+    Unarmed cost is one dict lookup; never runs under a jit trace (the
+    poison must be real data, not a tracer op)."""
+    from ..resilience.inject import plane
+
+    if not plane.armed("halo.nan") or _tracing(state):
+        return state
+    if not plane.fires("halo.nan"):
+        return state
+    rng = plane.site_rng("halo.nan")
+    n_rows = 0
+
+    def poison(x):
+        nonlocal n_rows
+        if not jnp.issubdtype(x.dtype, jnp.floating) or x.ndim < 2:
+            return x
+        k = min(4, x.shape[1])
+        d = rng.integers(x.shape[0], size=k)
+        r = rng.integers(x.shape[1], size=k)
+        n_rows += k
+        return x.at[jnp.asarray(d), jnp.asarray(r)].set(jnp.nan)
+
+    out = jax.tree_util.tree_map(poison, state)
+    if n_rows:
+        _metrics.inc("resilience.nan_rows_poisoned", n_rows)
+    return out
+
+
 def _tracing(state) -> bool:
     """Whether any leaf of ``state`` is an abstract tracer — i.e. the
     exchange is being called inside someone else's jit trace, where
@@ -351,6 +383,7 @@ class HaloExchange:
                 "got a HaloHandle where a state pytree belongs — pass the "
                 "handle as wait_remote_neighbor_copy_updates(state, handle)"
             )
+        state = _maybe_nan_storm(state)
         if _metrics.enabled and not _tracing(state):
             self._record(state, "blocking")
             t0 = time.perf_counter()

@@ -1,0 +1,42 @@
+"""Resilience layer: deterministic fault injection + crash-safe
+checkpoint lineage.
+
+The reference dccrg earns its production role through restart
+discipline — ``save_grid_data``'s offset-table format reloads on any
+process count (Honkonen et al., CPC 2013) — and HPC practice layers
+rotating multi-generation checkpoints on top so a torn write never
+strands a run (Moody et al., SC'10).  This package is the part that
+*proves* recovery works:
+
+* :mod:`~dccrg_tpu.resilience.inject` — a seeded, site-addressable
+  fault-injection plane (``DCCRG_FAULT=site:prob:seed`` or the
+  :class:`FaultPlane` API): torn/partial checkpoint writes, bit flips
+  in saved bytes, socket connect/accept/recv failures inside
+  ``utils/collectives.py``, NaN storms in halo payloads, and
+  SIGKILL-at-phase-boundary hooks for child processes.  Every trigger
+  is counted in the obs registry (``resilience.injected{site=...}``).
+* :mod:`~dccrg_tpu.resilience.manager` — rotating keep-N checkpoint
+  generations with fsync'd atomic commits and a checksummed MANIFEST;
+  ``latest_valid()`` scans back past torn/corrupt generations and
+  re-verifies the restored grid.
+
+The hardened checkpoint format itself (CRC32 over header, offset
+table, and per-cell payload chunks; typed :class:`CheckpointError`;
+``on_error="salvage"``) lives in ``io/checkpoint.py``; the retry/
+backoff plane for controller p2p sockets lives in
+``utils/collectives.py``.  ``tools/soak.py crash`` is the end-to-end
+proof harness: a SIGKILLed child must resume from ``latest_valid()``
+and converge to the uninterrupted run's final state across
+device-count changes.
+"""
+from .inject import FaultPlane, plane, fires, maybe_kill, corrupt_array
+from .manager import CheckpointLineage
+
+__all__ = [
+    "FaultPlane",
+    "plane",
+    "fires",
+    "maybe_kill",
+    "corrupt_array",
+    "CheckpointLineage",
+]

@@ -28,6 +28,7 @@ import numpy as np
 
 __all__ = [
     "process_count",
+    "retrying",
     "fetch",
     "allgather_u64",
     "allgather_u64_multi",
@@ -49,6 +50,65 @@ def process_count() -> int:
     import jax
 
     return jax.process_count()
+
+
+# --------------------------------------------------------------- retry plane
+
+def _retry_budget() -> int:
+    import os
+
+    return int(os.environ.get("DCCRG_P2P_RETRIES", "4"))
+
+
+def _retry_base() -> float:
+    import os
+
+    return float(os.environ.get("DCCRG_P2P_RETRY_BASE", "0.05"))
+
+
+def retrying(fn, what: str, peer=None, budget: int | None = None,
+             base: float | None = None, cap: float = 2.0):
+    """Run ``fn()`` with bounded exponential backoff + jitter on
+    transient ``OSError``s — the retry discipline for the controller p2p
+    transport's connect/accept/recv operations (ISSUE 4d).
+
+    Timeouts are NOT retried (each socket op already carries the long
+    ``DCCRG_P2P_TIMEOUT`` budget; retrying one would multiply it), and
+    neither is anything that is not an ``OSError``.  Each retry is
+    counted as ``p2p.retries{peer=...}``; once the budget
+    (``DCCRG_P2P_RETRIES``, default 4) is spent, a diagnostic
+    ``RuntimeError`` names the operation, peer, budget, and last error
+    — a clean abort instead of a hung or half-done exchange.
+    """
+    import random
+    import socket
+    import time
+
+    from ..obs import metrics
+
+    budget = _retry_budget() if budget is None else int(budget)
+    base = _retry_base() if base is None else float(base)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if isinstance(e, (socket.timeout, TimeoutError)):
+                raise
+            attempt += 1
+            if attempt > budget:
+                raise RuntimeError(
+                    f"p2p {what}"
+                    + (f" (peer {peer})" if peer is not None else "")
+                    + f": retry budget of {budget} exhausted "
+                    f"(last error: {e!r}); raise DCCRG_P2P_RETRIES if the "
+                    "network is transiently flaky, or investigate the peer"
+                ) from e
+            metrics.inc("p2p.retries",
+                        peer="?" if peer is None else str(peer))
+            # full jitter on an exponential envelope (AWS-style): the
+            # sleep is uniform in (0, base * 2^(attempt-1)], capped
+            time.sleep(random.uniform(0.0, min(cap, base * 2 ** (attempt - 1))))
 
 
 def fetch(x, dtype=None) -> np.ndarray:
@@ -367,10 +427,23 @@ class _P2PTransport:
         return float(os.environ.get("DCCRG_P2P_TIMEOUT", "120"))
 
     @staticmethod
-    def _recvn(sock, n: int) -> bytes:
+    def _recvn(sock, n: int, peer=None) -> bytes:
+        """Receive exactly ``n`` bytes.  Each ``recv`` runs under the
+        retry plane (transient ``OSError``s back off and retry within
+        the ``DCCRG_P2P_RETRIES`` budget; the ``p2p.recv`` injection
+        site fires before the real call, so armed faults exercise the
+        retry path without touching the kernel).  A retried ``recv``
+        re-requests only the still-missing bytes — nothing was consumed
+        when the previous attempt raised."""
+        from ..resilience import inject
+
         chunks = []
         while n:
-            b = sock.recv(n)
+            def attempt(want=n):
+                inject.maybe_raise("p2p.recv")
+                return sock.recv(want)
+
+            b = retrying(attempt, "recv", peer=peer)
             if not b:
                 raise ConnectionError("p2p peer closed mid-message")
             chunks.append(b)
@@ -416,10 +489,19 @@ class _P2PTransport:
 
         hdr_n = struct.calcsize(self._HEADER)
         # initiate toward higher ranks (lower rank of each pair connects)
+        from ..resilience import inject
+
         for p in (q for q in peers if q > self.rank):
             seq = self._pair_seq[p] = self._pair_seq.get(p, 0) + 1
             try:
-                s = socket.create_connection(self.addrs[p], timeout=timeout)
+                def connect(peer=p):
+                    inject.maybe_raise("p2p.connect",
+                                       exc=ConnectionRefusedError)
+                    return socket.create_connection(
+                        self.addrs[peer], timeout=timeout
+                    )
+
+                s = retrying(connect, "connect", peer=p)
             except (socket.timeout, TimeoutError) as e:
                 raise TimeoutError(
                     f"p2p connect to process {p} (pair seq {seq}) timed "
@@ -456,7 +538,11 @@ class _P2PTransport:
         self._listener.settimeout(timeout)
         while expect:
             try:
-                c, addr = self._listener.accept()
+                def accept():
+                    inject.maybe_raise("p2p.accept")
+                    return self._listener.accept()
+
+                c, addr = retrying(accept, "accept")
             except (socket.timeout, TimeoutError) as e:
                 raise TimeoutError(
                     f"p2p accept timed out after {timeout}s still waiting "
@@ -475,7 +561,7 @@ class _P2PTransport:
                 )
                 c.close()
                 continue
-            body = self._recvn(c, nbytes)
+            body = self._recvn(c, nbytes, peer=rk)
             if rk not in expect:
                 # a peer already in a later exchange that includes us —
                 # hold its message until we reach that exchange
@@ -493,9 +579,9 @@ class _P2PTransport:
         for p, seq, s in conns:
             try:
                 rk, r_seq, token, nbytes = struct.unpack(
-                    self._HEADER, self._recvn(s, hdr_n)
+                    self._HEADER, self._recvn(s, hdr_n, peer=p)
                 )
-                body = self._recvn(s, nbytes)
+                body = self._recvn(s, nbytes, peer=p)
             except (socket.timeout, TimeoutError) as e:
                 raise TimeoutError(
                     f"p2p response from process {p} (pair seq {seq}) "

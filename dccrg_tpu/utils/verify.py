@@ -16,7 +16,30 @@ import os
 import numpy as np
 from .collectives import fetch
 
-__all__ = ["verify_grid", "verify_user_data", "compare_epochs"]
+__all__ = ["verify_grid", "verify_user_data", "verify_finite",
+           "compare_epochs"]
+
+
+def verify_finite(grid, state, spec) -> None:
+    """Raise AssertionError naming the first field/device carrying a
+    non-finite value in a local (owned) row — the detection oracle for
+    halo NaN storms (the ``halo.nan`` injection site): a poisoned
+    payload row is owned by SOME device, so scanning local rows finds
+    every storm without double-reporting its ghost copies."""
+    epoch = grid.epoch
+    for name, (shape, dt) in spec.items():
+        if not np.issubdtype(np.dtype(dt), np.floating):
+            continue
+        arr = fetch(state[name])
+        for d in range(grid.n_devices):
+            rows = epoch.row_of[epoch.local_pos[d]]
+            vals = arr[d, rows]
+            if not np.isfinite(vals).all():
+                bad = int(np.count_nonzero(~np.isfinite(vals)))
+                raise AssertionError(
+                    f"non-finite values in field {name!r} on device {d} "
+                    f"({bad} entries) — corrupted payload (NaN storm?)"
+                )
 
 
 def compare_epochs(got, want) -> None:

@@ -18,6 +18,12 @@ Checks (exit 1 on any failure):
   timestamps) — :func:`validate_stream` / :func:`validate_chrome_trace`
   are also importable and runnable standalone on any such file
   (``--validate-stream`` / ``--validate-trace``);
+* a forced injection round (ISSUE 4): a bit-flipped lineage generation
+  must be detected by its payload CRC and skipped back to the clean
+  one, and an injected ``p2p.recv`` fault must drive the retry plane —
+  the probe fails unless ``resilience.injected``,
+  ``checkpoint.crc_failures``, ``lineage.generations_skipped`` and
+  ``p2p.retries`` all recorded;
 * unless ``--skip-overhead``: enabling telemetry must not slow the
   workload's step loop by more than ``--threshold`` (default 1.05 =
   5%) vs the disabled mode — the zero-cost-when-disabled and
@@ -41,7 +47,7 @@ import time
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 #: the phase set the acceptance criteria require (ISSUE 1; ISSUE 3 adds
-#: the incremental rebuild phase)
+#: the incremental rebuild phase; ISSUE 4 the lineage phases)
 REQUIRED_PHASES = (
     "halo.exchange",
     "epoch.build",
@@ -49,6 +55,8 @@ REQUIRED_PHASES = (
     "loadbalance.migrate",
     "amr.refine",
     "checkpoint.write",
+    "lineage.commit",
+    "lineage.scan",
 )
 
 #: counters that must be nonzero after the workload
@@ -60,6 +68,14 @@ REQUIRED_NONZERO_COUNTERS = (
     # the probe's small second commit must take the incremental path,
     # not fall back — a silent fallback is a coverage loss
     "epoch.delta_builds",
+    # ISSUE 4: the forced injection round must leave the full
+    # detection-path evidence — an injected fault that is not counted,
+    # or a corrupt generation whose CRC failure is not counted, means
+    # the resilience plane silently lost coverage
+    "resilience.injected",
+    "checkpoint.crc_failures",
+    "lineage.generations_skipped",
+    "p2p.retries",
 )
 
 
@@ -262,6 +278,61 @@ def drive(g, adv, state, dt, steps: int):
     return state
 
 
+def _resilience_probe(g, state) -> list:
+    """Forced injection round (ISSUE 4): arm a bit flip, commit two
+    lineage generations (one corrupt), and require the full detection
+    path to fire — the lineage scan must skip the corrupt generation on
+    its payload CRC and resume the clean one — plus one injected
+    ``p2p.recv`` fault driven through the real transport receive loop
+    so the retry/backoff counter records.  Returns failure strings."""
+    import socket
+
+    import numpy as np
+
+    failures: list = []
+    from dccrg_tpu.io.checkpoint import CheckpointError
+    from dccrg_tpu.resilience import CheckpointLineage, plane
+    from dccrg_tpu.utils.collectives import _P2PTransport
+
+    spec = {"density": ((), np.float32)}
+    with tempfile.TemporaryDirectory() as td:
+        lineage = CheckpointLineage(os.path.join(td, "lineage"), keep=3)
+        clean_gen = lineage.commit(g, state, spec, user_header=b"clean")
+        plane.arm("checkpoint.bit_flip", prob=1.0, seed=0, count=1)
+        try:
+            corrupt_gen = lineage.commit(g, state, spec,
+                                         user_header=b"corrupt")
+        finally:
+            plane.disarm("checkpoint.bit_flip")
+        try:
+            _g2, _s2, hdr, gen = lineage.latest_valid(spec, n_devices=1)
+            if gen != clean_gen or hdr != b"clean":
+                failures.append(
+                    f"lineage scan resumed generation {gen} ({hdr!r}) "
+                    f"instead of skipping corrupt generation "
+                    f"{corrupt_gen} back to {clean_gen}"
+                )
+        except CheckpointError as e:
+            failures.append(f"lineage scan found no valid generation: {e}")
+
+    # injected recv fault through the real _recvn loop: first attempt
+    # raises, backoff fires, the retry drains the socket
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"probe-ok")
+        plane.arm("p2p.recv", prob=1.0, seed=0, count=1)
+        try:
+            got = _P2PTransport._recvn(a, 8, peer=0)
+        finally:
+            plane.disarm("p2p.recv")
+        if got != b"probe-ok":
+            failures.append(f"retried recv returned {got!r}")
+    finally:
+        a.close()
+        b.close()
+    return failures
+
+
 def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
               reps: int = 5, threshold: float = 1.05) -> list:
     """Run the workload + checks; returns a list of failure strings
@@ -294,6 +365,8 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
         )
         if not same:
             failures.append("checkpoint round-trip altered the payload")
+
+    failures += _resilience_probe(g, state)
 
     report = g.report()
     for phase in REQUIRED_PHASES:

@@ -6,13 +6,22 @@ discipline applied as fuzzing — run it after substantial changes:
 
     python tools/soak.py all --seeds 0 25
     python tools/soak.py paths --seeds 0 100
+    python tools/soak.py crash --seeds 0 5
 
 Subsystems: paths (boxed/flat advection vs general), three_level,
 amr (commit pipeline + verify + mass), checkpoint (round trips across
 device counts), particles, gol (all four variants), hoods (user
 neighborhoods), vlasov (conservation + fused-kernel bit-identity),
 poisson (flat/gather solve differential under the restart driver +
-fused whole-solve kernel).
+fused whole-solve kernel), crash (SIGKILL/resume convergence through
+the checkpoint lineage: the child runs GoL + advection with periodic
+lineage commits while being killed — by injected SIGKILLs at commit
+boundaries AND by the parent at random wall-clock times — and every
+resume, possibly at a different device count, must converge to the
+uninterrupted run's final state: GoL exactly, advection within the
+cross-layout tolerance).  Per-seed crash/resume outcomes stream into
+the telemetry JSONL (``obs/stream.py``), so a hung crash-soak leaves
+evidence of which generation each attempt was resuming from.
 """
 import argparse
 import pathlib
@@ -733,6 +742,285 @@ print("POISSON_FUZZ_OK")
 """
 
 
+#: the crash-subsystem child: a resume-capable GoL + advection run with
+#: periodic checkpoint-lineage commits.  Launched repeatedly by
+#: run_crash(); any launch may die (injected SIGKILL at a commit
+#: boundary via DCCRG_FAULT, or the parent's random-time SIGKILL) and
+#: the next launch must resume from latest_valid() — possibly at a
+#: DIFFERENT device count — and still converge to the uninterrupted
+#: run's final state.  argv: workdir seed n_devices total_steps every
+CRASH_CHILD = r"""import sys
+wd, seed, nd, total, every = (sys.argv[1], int(sys.argv[2]),
+                              int(sys.argv[3]), int(sys.argv[4]),
+                              int(sys.argv[5]))
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', nd)
+except AttributeError:   # old jax: pre-init XLA_FLAGS is the only knob
+    import os as _os
+    if 'xla_force_host_platform_device_count' not in _os.environ.get('XLA_FLAGS', ''):
+        _os.environ['XLA_FLAGS'] = (_os.environ.get('XLA_FLAGS', '')
+            + ' --xla_force_host_platform_device_count=%d' % nd).strip()
+jax.config.update('jax_enable_x64', True)
+import os
+import numpy as np
+sys.path.insert(0, __DCCRG_ROOT__)
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.io.checkpoint import CheckpointError
+from dccrg_tpu.models import Advection, GameOfLife
+from dccrg_tpu.resilience.manager import CheckpointLineage
+
+obs.stream_to(os.path.join(wd, 'child_stream.jsonl'), period=2.0,
+              extra={'subsystem': 'crash', 'seed': seed, 'n_devices': nd})
+
+
+def atomic_save(path, arr):
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ---- phase 1: Game of Life (exact across device counts) -------------
+final = os.path.join(wd, 'gol_final.npy')
+if not os.path.exists(final):
+    rng = np.random.default_rng(seed)
+    g = (Grid().set_initial_length((10, 10, 1)).set_neighborhood_length(1)
+         .set_periodic(True, True, False)
+         .initialize(mesh=make_mesh(n_devices=nd)))
+    cells = g.get_cells()
+    alive0 = cells[rng.random(len(cells)) < 0.35]
+    lineage = CheckpointLineage(os.path.join(wd, 'gol'), keep=3)
+    try:
+        g, s, hdr, gen = lineage.latest_valid(GameOfLife.SPEC, n_devices=nd)
+        step = int(hdr)
+        gol = GameOfLife(g)
+        print('RESUMED gol gen=%d step=%d' % (gen, step), flush=True)
+    except CheckpointError:
+        gol = GameOfLife(g)
+        s = gol.new_state(alive_cells=alive0)
+        step = 0
+        print('FRESH gol', flush=True)
+    while step < total:
+        s = gol.run(s, 1)
+        step += 1
+        if step % every == 0:
+            lineage.commit(g, s, GameOfLife.SPEC,
+                           user_header=str(step).encode())
+    atomic_save(final, np.sort(gol.alive_cells(s)))
+
+# ---- phase 2: advection (within documented tolerance) ---------------
+final = os.path.join(wd, 'adv_final.npy')
+if not os.path.exists(final):
+    rng = np.random.default_rng(seed + 1)
+    n = 4
+    g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
+         .set_periodic(True, True, True).set_maximum_refinement_level(1)
+         .set_geometry(CartesianGeometry, start=(0., 0., 0.),
+                       level_0_cell_length=(1. / n,) * 3)
+         .initialize(mesh=make_mesh(n_devices=nd)))
+    ids0 = g.get_cells()
+    for cid in rng.choice(ids0, size=max(1, len(ids0) // 5), replace=False):
+        g.refine_completely(int(cid))
+    g.stop_refining()
+    ids = g.get_cells()
+    # deterministic initial conditions from the seed — regenerated on
+    # every launch, discarded when a lineage resume takes over
+    dens0 = rng.uniform(1, 2, len(ids))
+    vels0 = {f: rng.uniform(-0.2, 0.2, len(ids)) for f in ('vx', 'vy', 'vz')}
+    adv = Advection(g)
+    spec = {k: adv.spec[k] for k in ('density', 'vx', 'vy', 'vz')}
+    s0 = adv.initialize_state()
+    s0 = adv.set_cell_data(s0, 'density', ids, dens0)
+    for f in ('vx', 'vy', 'vz'):
+        s0 = adv.set_cell_data(s0, f, ids, vels0[f])
+    s0 = g.update_copies_of_remote_neighbors(s0)
+    dt = 0.3 * adv.max_time_step(s0)
+    lineage = CheckpointLineage(os.path.join(wd, 'adv'), keep=3)
+    try:
+        g2, s2, hdr, gen = lineage.latest_valid(spec, n_devices=nd)
+        step = int(hdr)
+        adv = Advection(g2)
+        s = adv.initialize_state()
+        for f in spec:
+            s = adv.set_cell_data(s, f, ids, g2.get_cell_data(s2, f, ids))
+        s = g2.update_copies_of_remote_neighbors(s)
+        g = g2
+        print('RESUMED adv gen=%d step=%d' % (gen, step), flush=True)
+    except CheckpointError:
+        s = s0
+        step = 0
+        print('FRESH adv', flush=True)
+    while step < total:
+        s = adv.step(s, dt)
+        step += 1
+        if step % every == 0:
+            lineage.commit(g, s, spec, user_header=str(step).encode())
+    atomic_save(final, np.asarray(g.get_cell_data(s, 'density', ids),
+                                  np.float64))
+
+print('CRASH_CHILD_DONE', flush=True)
+"""
+
+
+def run_crash(lo: int, hi: int, stream_dir: str | None = None,
+              total_steps: int = 24, every: int = 3) -> bool:
+    """The crash/resume proof harness (ISSUE 4e).  Per seed:
+
+    1. an uninterrupted reference child runs to completion;
+    2. a crash child runs the same workload with lineage checkpoints
+       while being killed — even attempts arm an injected SIGKILL at a
+       random commit boundary plus occasional torn writes
+       (``DCCRG_FAULT``), odd attempts get SIGKILLed by THIS process at
+       a random wall-clock moment (which can land mid-write or
+       mid-manifest-rewrite — the genuinely torn cases); each relaunch
+       resumes from ``latest_valid()`` at a possibly different device
+       count;
+    3. once a launch completes, the final states must match the
+       reference: GoL exactly, advection to the documented 1e-11
+       cross-layout tolerance.
+
+    Every attempt's outcome (exit status, kill mode, which generation
+    the resume picked up) is appended to the streaming telemetry JSONL.
+    """
+    import json
+    import os
+    import re
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    stream = None
+    if stream_dir:
+        os.makedirs(stream_dir, exist_ok=True)
+        if str(ROOT) not in sys.path:
+            sys.path.insert(0, str(ROOT))
+        from dccrg_tpu.obs.stream import TelemetryStream
+
+        stream = TelemetryStream(
+            os.path.join(stream_dir, f"crash_{lo}_{hi}.jsonl"),
+            truncate=True, extra={"subsystem": "crash", "seeds": [lo, hi]},
+        )
+
+    def record(**kw):
+        if stream is not None:
+            stream.write_snapshot(**kw)
+
+    def launch(workdir, seed, nd, env_extra=None):
+        env = dict(os.environ)
+        env.pop("DCCRG_FAULT", None)
+        env.update(env_extra or {})
+        log = open(os.path.join(workdir, "child.log"), "a")
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             CRASH_CHILD.replace("__DCCRG_ROOT__", repr(str(ROOT))),
+             workdir, str(seed), str(nd), str(total_steps), str(every)],
+            cwd=str(ROOT), stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        return p, log
+
+    def resumes_of(workdir):
+        try:
+            with open(os.path.join(workdir, "child.log")) as f:
+                return re.findall(r"(?:RESUMED|FRESH) [^\n]*", f.read())[-4:]
+        except OSError:
+            return []
+
+    nd_cycle = (2, 1, 4)
+    max_attempts = 8
+    ok_all = True
+    for seed in range(lo, hi):
+        rng = np.random.default_rng(10_000 + seed)
+        tmp = tempfile.mkdtemp(prefix=f"dccrg_crash_{seed}_")
+        try:
+            # 1. uninterrupted reference
+            ref = os.path.join(tmp, "ref")
+            os.makedirs(ref)
+            nd_ref = int(rng.choice(nd_cycle))
+            p, log = launch(ref, seed, nd_ref)
+            rc = p.wait()
+            log.close()
+            if rc != 0:
+                print(f"crash seed {seed}: reference run failed rc={rc}")
+                print(open(os.path.join(ref, "child.log")).read()[-2000:])
+                record(seed=seed, outcome="reference-failed", exit=rc)
+                ok_all = False
+                continue
+
+            # 2. crash/resume until a launch completes
+            wd = os.path.join(tmp, "crash")
+            os.makedirs(wd)
+            rc = -1
+            for attempt in range(max_attempts):
+                nd = nd_cycle[attempt % len(nd_cycle)]
+                last = attempt == max_attempts - 1
+                env_extra, kill_mode = {}, "none"
+                if not last and attempt % 2 == 0:
+                    kill_mode = "inject-sigkill"
+                    env_extra["DCCRG_FAULT"] = (
+                        f"sigkill.post_commit:0.6:{seed * 97 + attempt}:1"
+                        f":{int(rng.integers(0, 4))}"
+                        f",checkpoint.torn_write:0.07:{seed * 31 + attempt}"
+                    )
+                elif not last:
+                    kill_mode = "parent-kill"
+                p, log = launch(wd, seed, nd, env_extra)
+                if kill_mode == "parent-kill":
+                    try:
+                        p.wait(timeout=float(rng.uniform(2.0, 10.0)))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                try:
+                    # hang guard: a wedged child is killed and recorded
+                    # as such; the stream keeps the evidence
+                    rc = p.wait(timeout=600)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rc = p.wait()
+                    kill_mode += "+hang-guard"
+                log.close()
+                record(seed=seed, attempt=attempt, n_devices=nd,
+                       kill=kill_mode, exit=rc, resumes=resumes_of(wd))
+                if rc == 0:
+                    break
+            if rc != 0:
+                print(f"crash seed {seed}: no attempt completed "
+                      f"(last rc={rc})")
+                print(open(os.path.join(wd, "child.log")).read()[-2000:])
+                record(seed=seed, outcome="never-completed", exit=rc)
+                ok_all = False
+                continue
+
+            # 3. convergence against the reference
+            try:
+                gol_ref = np.load(os.path.join(ref, "gol_final.npy"))
+                gol_got = np.load(os.path.join(wd, "gol_final.npy"))
+                np.testing.assert_array_equal(gol_got, gol_ref)
+                adv_ref = np.load(os.path.join(ref, "adv_final.npy"))
+                adv_got = np.load(os.path.join(wd, "adv_final.npy"))
+                np.testing.assert_allclose(adv_got, adv_ref,
+                                           rtol=1e-11, atol=0)
+            except AssertionError as e:
+                print(f"crash seed {seed}: DIVERGED after resume: "
+                      f"{str(e)[:200]}")
+                record(seed=seed, outcome="diverged")
+                ok_all = False
+                continue
+            record(seed=seed, outcome="ok", attempts=attempt + 1)
+            print(f"crash seed {seed}: OK after {attempt + 1} attempt(s)")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if stream is not None:
+        stream.stop(final=True)
+    print(f"{'crash':12s} [{lo},{hi}): {'OK' if ok_all else 'FAIL'}")
+    return ok_all
+
+
 #: prepended to every child body when streaming is on: appends an
 #: incremental registry snapshot as JSONL every few seconds (plus a
 #: final one at exit), so a hung or killed seed leaves the phase
@@ -798,8 +1086,12 @@ def run(name: str, lo: int, hi: int, stream_dir: str | None = None) -> bool:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("subsystem", choices=list(BODIES) + ["all"])
+    ap.add_argument("subsystem", choices=list(BODIES) + ["crash", "all"])
     ap.add_argument("--seeds", type=int, nargs=2, default=(0, 10))
+    ap.add_argument("--crash-seeds", type=int, nargs=2, default=None,
+                    help="seed range for the crash subsystem under "
+                         "'all' (default: first 3 of --seeds; each "
+                         "crash seed launches several child processes)")
     ap.add_argument("--stream-dir",
                     default=str(ROOT / "tools" / "soak_stream"),
                     help="per-subsystem incremental telemetry JSONL "
@@ -809,8 +1101,17 @@ def main():
     a = ap.parse_args()
     names = list(BODIES) if a.subsystem == "all" else [a.subsystem]
     sdir = None if a.no_stream else a.stream_dir
-    ok = all([run(n, *a.seeds, stream_dir=sdir) for n in names])
-    sys.exit(0 if ok else 1)
+    results = []
+    if a.subsystem == "crash":
+        results.append(run_crash(*a.seeds, stream_dir=sdir))
+    else:
+        results += [run(n, *a.seeds, stream_dir=sdir)
+                    for n in names if n != "crash"]
+        if a.subsystem == "all":
+            lo, hi = a.crash_seeds or (a.seeds[0],
+                                       min(a.seeds[0] + 3, a.seeds[1]))
+            results.append(run_crash(lo, hi, stream_dir=sdir))
+    sys.exit(0 if all(results) else 1)
 
 
 if __name__ == "__main__":

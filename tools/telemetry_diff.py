@@ -59,6 +59,16 @@ DEFAULT_PHASES = (
     "checkpoint.read",
 )
 
+#: phases reported but never gated (merged with --allow): the ISSUE 4
+#: resilience phases time fault-injection rounds and recovery scans,
+#: whose cost is dominated by how many faults the round armed and how
+#: many generations the scan had to skip — round-over-round variation
+#: there is workload-shaped, not a perf regression
+DEFAULT_ALLOW = (
+    "lineage.commit",
+    "lineage.scan",
+)
+
 
 def load_phases(path: str) -> dict:
     """Phase table ``{name: {total_s, count, mean_s}}`` from any of the
@@ -262,7 +272,8 @@ def main(argv=None) -> int:
                     help="comma-separated gated phases ('' = all)")
     ap.add_argument("--allow", action="append", default=[],
                     help="phase allowed to regress (repeatable, or "
-                         "comma-separated)")
+                         "comma-separated; the resilience phases "
+                         f"{', '.join(DEFAULT_ALLOW)} are always allowed)")
     ap.add_argument("--json", default=None,
                     help="also write the verdict record to this path")
     ap.add_argument("--history",
@@ -290,7 +301,9 @@ def main(argv=None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"telemetry_diff: cannot load inputs: {e}", file=sys.stderr)
         return 2
-    allow = [a for chunk in args.allow for a in chunk.split(",") if a]
+    allow = list(DEFAULT_ALLOW) + [
+        a for chunk in args.allow for a in chunk.split(",") if a
+    ]
     phases = [p for p in args.phases.split(",") if p] or None
     verdict = compare(current, baseline, threshold=args.threshold,
                       phases=phases, allow=allow, min_total=args.min_total)
