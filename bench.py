@@ -1026,6 +1026,35 @@ def _write_telemetry() -> None:
         print(f"telemetry diff failed: {e}", file=sys.stderr)
 
 
+def _attach_epoch_churn(record: dict) -> None:
+    """Fold the shape-stability churn sweep (ISSUE 5) into the record:
+    rebuild→first-step latency and cumulative compile counts, bucketed
+    vs forced-exact shapes — run on the CPU backend in a child so an
+    accelerator outage or a crash never blocks the bench line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from benchmarks.microbench import churn_compile_summary; "
+        "print(json.dumps(churn_compile_summary(length=10, cycles=4)))"
+        % str(ROOT)
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            print(f"epoch churn probe failed: {r.stderr[-300:]}",
+                  file=sys.stderr)
+            return
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        record.setdefault("detail", {})["epoch_churn"] = json.loads(line)
+    except Exception as e:  # noqa: BLE001 - telemetry never kills the bench
+        print(f"epoch churn probe failed: {e}", file=sys.stderr)
+
+
 def _attach_telemetry(record: dict) -> None:
     """Fold telemetry.json's phase breakdown into the bench record so
     BENCH_*.json rounds carry where epoch/halo/LB/AMR/checkpoint time
@@ -1060,6 +1089,24 @@ def _attach_telemetry(record: dict) -> None:
                 "delta_fallbacks": counters.get(
                     "epoch.delta_fallbacks", {}),
             },
+            # ISSUE 5: shape-stable epochs — kernel (re)compiles, the
+            # compile phase and the executable-cache hit rate, so the
+            # round-over-round gate sees a regression in trace churn
+            "shape_stability": {
+                "compile_mean_s": phases.get("compile", {}).get("mean_s"),
+                "compile_count": phases.get("compile", {}).get("count"),
+                "recompiles": counters.get("epoch.recompiles", {}),
+                "cache_hits": counters.get(
+                    "epoch.cache_hits", {}).get(""),
+                "cache_misses": counters.get(
+                    "epoch.cache_misses", {}).get(""),
+                "cache_evictions": counters.get(
+                    "epoch.cache_evictions", {}).get(""),
+                "delta_builds_by_kind": {
+                    k: v for k, v in counters.get(
+                        "epoch.delta_builds", {}).items() if k
+                },
+            },
         }
     except (OSError, ValueError) as e:
         print(f"could not attach telemetry.json: {e}", file=sys.stderr)
@@ -1086,6 +1133,7 @@ def _emit(record: dict):
     tail capture always round-trips through json.loads (VERDICT-r4
     weak #1) — in the outage fallback too."""
     _attach_telemetry(record)
+    _attach_epoch_churn(record)
     try:
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
     except OSError as e:

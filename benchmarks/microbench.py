@@ -340,6 +340,138 @@ def bench_epoch_churn(length: int = 48,
         }))
 
 
+def churn_compile_summary(length: int = 12, cycles: int = 6, seed: int = 0,
+                          n_devices: int = 1) -> dict:
+    """Rebuild→first-step latency + cumulative kernel compiles across a
+    churn storm sweep (ISSUE 5's acceptance workload), importable so
+    ``bench.py`` can fold it into BENCH_DETAIL.json.
+
+    Runs the same randomized refine/unrefine churn twice — shape buckets
+    + executable cache ON (the default) vs forced-exact shapes
+    (``DCCRG_EPOCH_BUCKETS=0``, fresh per-epoch shapes) — and reports,
+    per cycle, the wall time from committing the structural change to
+    the first model step completing, plus the cumulative trace count.
+    With sticky shapes every post-warmup cycle should re-dispatch cached
+    executables (near-zero compile cost); with exact shapes every cycle
+    retraces."""
+    import jax
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import Advection
+    from dccrg_tpu.parallel.exec_cache import trace_counts
+
+    def run_variant(bucketed: bool) -> dict:
+        prev = os.environ.get("DCCRG_EPOCH_BUCKETS")
+        os.environ["DCCRG_EPOCH_BUCKETS"] = "1" if bucketed else "0"
+        try:
+            g = (
+                Grid()
+                .set_initial_length((length, length, length))
+                .set_neighborhood_length(1)
+                .set_periodic(True, True, True)
+                .set_maximum_refinement_level(2)
+                .set_geometry(
+                    CartesianGeometry,
+                    start=(0.0, 0.0, 0.0),
+                    level_0_cell_length=(1.0 / length,) * 3,
+                )
+                .initialize(mesh=make_mesh(n_devices=n_devices))
+            )
+            rng = np.random.default_rng(seed)
+            ids = g.get_cells()
+            ctr = g.geometry.get_center(ids)
+            g.refine_completely_many(
+                ids[np.linalg.norm(ctr - 0.5, axis=1) < 0.25]
+            )
+            g.stop_refining()
+            adv = Advection(g, dtype=np.float32, allow_dense=False)
+            state = adv.initialize_state()
+            dt = np.float32(0.25 * adv.max_time_step(state))
+            state = adv.step(state, dt)
+            jax.block_until_ready(state["density"])
+
+            lat, compiles, steps_s = [], [], []
+            for _ in range(cycles):
+                # volume-balanced storm: every refined family is offset
+                # by an unrefined one, so the churn exercises rebuilds
+                # without monotonic growth (real AMR tracks a feature;
+                # it does not inflate the grid 25% per commit)
+                ids = g.get_cells()
+                lvl = g.mapping.get_refinement_level(ids)
+                coarse = ids[lvl < 2]
+                pick = rng.choice(len(coarse), size=min(6, len(coarse)),
+                                  replace=False)
+                g.refine_completely_many(coarse[pick])
+                fine = ids[lvl == 2]
+                if len(fine):
+                    # whole families only, so the unrefine volume really
+                    # lands (a lone sibling request cannot commit)
+                    parents = np.unique(g.mapping.get_parent(fine))
+                    sibs = g.mapping.get_all_children(parents)
+                    whole = np.isin(sibs, fine).all(axis=1)
+                    fams = sibs[whole]
+                    if len(fams):
+                        fpick = rng.choice(len(fams),
+                                           size=min(6, len(fams)),
+                                           replace=False)
+                        g.unrefine_completely_many(
+                            fams[fpick].reshape(-1)
+                        )
+                c0 = sum(trace_counts().values())
+                t0 = time.perf_counter()
+                g.stop_refining()
+                adv = Advection(g, dtype=np.float32, allow_dense=False)
+                state = adv.initialize_state()
+                state = adv.step(state, dt)
+                jax.block_until_ready(state["density"])
+                lat.append(time.perf_counter() - t0)
+                compiles.append(sum(trace_counts().values()) - c0)
+                # steady-state step time (post-compile)
+                t0 = time.perf_counter()
+                state = adv.step(state, dt)
+                jax.block_until_ready(state["density"])
+                steps_s.append(time.perf_counter() - t0)
+            return {
+                "rebuild_to_first_step_s": [round(v, 4) for v in lat],
+                "compiles_per_cycle": compiles,
+                "steady_step_s": [round(v, 5) for v in steps_s],
+                "total_compiles": int(sum(compiles)),
+                "n_cells": int(len(g.get_cells())),
+            }
+        finally:
+            if prev is None:
+                os.environ.pop("DCCRG_EPOCH_BUCKETS", None)
+            else:
+                os.environ["DCCRG_EPOCH_BUCKETS"] = prev
+
+    out = {
+        "length": length,
+        "cycles": cycles,
+        "n_devices": n_devices,
+        "bucketed": run_variant(True),
+        "exact_shapes": run_variant(False),
+    }
+    b, e = out["bucketed"], out["exact_shapes"]
+    out["warm_latency_ratio"] = round(
+        float(np.median(e["rebuild_to_first_step_s"][1:]))
+        / max(float(np.median(b["rebuild_to_first_step_s"][1:])), 1e-9), 2,
+    )
+    return out
+
+
+def bench_churn_compile(length: int = 12, cycles: int = 6):
+    """Print the :func:`churn_compile_summary` sweep as a bench metric:
+    value = warm-cycle latency advantage of sticky shapes (exact-shape
+    rebuild→first-step time over bucketed+cached)."""
+    s = churn_compile_summary(length=length, cycles=cycles)
+    print(json.dumps({
+        "metric": "epoch_churn_rebuild_to_first_step_speedup",
+        "value": s["warm_latency_ratio"],
+        "unit": "x (exact/bucketed, median warm cycle)",
+        "detail": s,
+    }))
+
+
 def pic_setup(n_particles: int, length: int = 32, *, max_ref: int = 0,
               refine_ball: float | None = None,
               balance_method: str | None = None, seed: int = 0):
@@ -442,6 +574,7 @@ def main():
     bench_checkpoint(args.checkpoint_length)
     bench_epoch_rebuild()
     bench_epoch_churn(args.churn_length)
+    bench_churn_compile()
     bench_particles(args.particles)
 
 

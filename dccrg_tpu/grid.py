@@ -26,8 +26,10 @@ from .core.neighborhood import default_neighborhood, validate_neighborhood
 from .core.neighbors import InconsistentGridError, LeafSet
 from .geometry import CartesianGeometry, NoGeometry
 from .parallel.epoch import build_epoch
+from .parallel.exec_cache import ExecutableCache
 from .parallel.halo import HaloExchange
 from .parallel.mesh import SHARD_AXIS, make_mesh, shard_spec
+from .parallel.shapes import epoch_shape_hints, signature_of
 from .parallel.partition import block_partition, hilbert_partition, morton_partition
 from .utils.collectives import fetch
 
@@ -140,6 +142,15 @@ class Grid:
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
         self._last_adaptation_delta = None
         self._prev_epoch = None
+        # compiled-schedule cache + recycled table buffers: both survive
+        # every epoch rebuild (the whole point — see parallel/shapes.py)
+        from .parallel.epoch_delta import TablePool
+
+        self.exec_cache = ExecutableCache()
+        self._table_pool = TablePool()
+        # ring-size hysteresis hints (parallel/halo.py): shared by every
+        # schedule this grid compiles, surviving rebuilds
+        self._ring_hints = {}
 
         if leaf_set is not None:
             cells = np.unique(np.asarray(leaf_set, dtype=np.uint64))
@@ -241,6 +252,37 @@ class Grid:
         its first cell)."""
         return bool(getattr(self.geometry, "uniform_level0", False))
 
+    def _shape_hints(self) -> dict:
+        """Bucket-hysteresis hints from the current epoch (empty before
+        the first build) — see ``parallel/shapes.py``."""
+        return epoch_shape_hints(getattr(self, "epoch", None))
+
+    def shape_signature(self):
+        """The current epoch's :class:`~dccrg_tpu.parallel.shapes.
+        ShapeSignature` — the identity compiled schedules are keyed by.
+        Two epochs with equal signatures share every cached executable
+        (``grid.exec_cache``); a rebuild that keeps the signature costs
+        zero retraces."""
+        return signature_of(self.epoch)
+
+    def _harvest_tables(self, old_epoch) -> None:
+        """Park a retired epoch's gather-table buffers for reuse by the
+        next delta patch — unless the epoch is shared with another grid
+        (``copy_structure``), whose tables must stay intact."""
+        if old_epoch is None or getattr(old_epoch, "_shared", False):
+            return
+        # multi-controller put_table hands jitted code the HOST arrays
+        # themselves (no device copy) — recycling them would mutate live
+        # schedule constants
+        if jax.process_count() > 1:
+            return
+        for h in old_epoch.hoods.values():
+            self._table_pool.put(
+                (h.nbr_rows, h.nbr_valid, h.nbr_offset, h.nbr_len,
+                 h.nbr_slot)
+            )
+        old_epoch.hoods = {}
+
     def _rebuild(self):
         """Recompute every derived structure for the current leaf set —
         the analogue of the reference's post-mutation rebuild tail
@@ -250,6 +292,7 @@ class Grid:
             self.mapping, self.topology, self.leaves, self.n_devices,
             self.neighborhoods,
             uniform_geometry=self._uniform_geometry(),
+            shape_hints=self._shape_hints(),
         )
         self._halo_cache = {}
         self._id_pos_cache = None
@@ -261,7 +304,9 @@ class Grid:
         O(|touched| · K) instead of the full O(N · K) rebuild — falling
         back to ``build_epoch`` (the semantic oracle) whenever the delta
         path declines (closure too large, row-budget jump, dense-path
-        flip; see ``epoch_delta.FALLBACK_REASONS``)."""
+        flip; see ``epoch_delta.FALLBACK_REASONS``).  Shape hints keep
+        the bucketed table shapes sticky, and the retired epoch's table
+        buffers are recycled into ``_table_pool`` for the next patch."""
         from .parallel.epoch_delta import build_epoch_delta
 
         epoch = None
@@ -269,6 +314,8 @@ class Grid:
             epoch = build_epoch_delta(
                 old_epoch, self.leaves, self.n_devices, self.neighborhoods,
                 uniform_geometry=self._uniform_geometry(),
+                shape_hints=epoch_shape_hints(old_epoch),
+                table_pool=getattr(self, "_table_pool", None),
             )
         if epoch is None:
             self._rebuild()
@@ -417,6 +464,10 @@ class Grid:
 
         g.amr = AmrQueues()
         g._halo_cache = dict(self._halo_cache)
+        # the shared epoch's tables must never be recycled into either
+        # grid's buffer pool while the other may still read them
+        if hasattr(self, "epoch"):
+            self.epoch._shared = True
         return g
 
     # -------------------------------------------------- options / getters
@@ -551,11 +602,15 @@ class Grid:
                 self._halo_cache[key] = HaloExchange(
                     self.epoch, self.epoch.hoods[hood_id], self.mesh,
                     cell_datatype=policy, hood_id=hood_id,
+                    exec_cache=self.exec_cache,
+                    ring_hints=self._ring_hints,
                 )
             return self._halo_cache[key]
         return HaloExchange(
             self.epoch, self.epoch.hoods[hood_id], self.mesh,
             cell_datatype=policy, hood_id=hood_id,
+            exec_cache=self.exec_cache,
+            ring_hints=self._ring_hints,
         )
 
     def update_copies_of_remote_neighbors(self, state, hood_id=None):
@@ -752,6 +807,7 @@ class Grid:
             self.leaves = LeafSet(cells=self.leaves.cells, owner=owner)
             self._rebuild_incremental(old_epoch)
             self._prev_epoch = _EpochCarry(old_epoch)
+            self._harvest_tables(old_epoch)
         return self
 
     def _lb_telemetry(self, old_owner, new_owner):
@@ -943,12 +999,15 @@ class Grid:
             new_epoch = build_epoch_delta(
                 self.epoch, new_leaves, self.n_devices, self.neighborhoods,
                 uniform_geometry=self._uniform_geometry(),
+                shape_hints=self._shape_hints(),
+                table_pool=getattr(self, "_table_pool", None),
             )
             if new_epoch is None:
                 new_epoch = build_epoch(
                     self.mapping, self.topology, new_leaves, self.n_devices,
                     self.neighborhoods,
                     uniform_geometry=self._uniform_geometry(),
+                    shape_hints=self._shape_hints(),
                 )
         self._staged_lb = {
             "noop": False,
@@ -1024,11 +1083,13 @@ class Grid:
                 "migration is partial; pass the state to finish_balance_load"
             )
         self._staged_lb = None
-        self._prev_epoch = _EpochCarry(self.epoch)
+        old_epoch = self.epoch
+        self._prev_epoch = _EpochCarry(old_epoch)
         self._last_new_cells = np.zeros(0, dtype=np.uint64)
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
         self.leaves = st["leaves"]
         self.epoch = st["epoch"]
+        self._harvest_tables(old_epoch)
         self._halo_cache = {}
         self._id_pos_cache = None
         if st["staged"] is None:
@@ -1450,6 +1511,7 @@ class Grid:
                 return new_cells.copy()
             self._rebuild_incremental(old_epoch)
             self._prev_epoch = _EpochCarry(old_epoch)
+            self._harvest_tables(old_epoch)
         return new_cells.copy()
 
     def get_removed_cells(self) -> np.ndarray:

@@ -166,6 +166,11 @@ class Advection:
             return
         self.tables = StencilTables(grid, hood_id, with_geometry=True)
         self._exchange = grid.halo(hood_id)
+        # halo schedule tables ride into the cached kernels as runtime
+        # arguments (parallel/exec_cache.py): an epoch rebuild with the
+        # same shape signature reuses every compiled step
+        self._rings = (tuple(self._exchange.ring_send)
+                       + tuple(self._exchange.ring_recv))
         self._build_face_tables()
         self._step = self._build_step()
         self._max_dt = self._build_max_dt()
@@ -219,91 +224,129 @@ class Advection:
 
     # -------------------------------------------------------------- kernels
 
+    def _kernel_key(self, name: str) -> tuple:
+        return (name, self._exchange.structure_key,
+                str(np.dtype(self.dtype)))
+
     def _build_step(self):
-        t = self.tables.tree()
-        dev = self._dev
-        exchange = self._exchange
+        from ..parallel.exec_cache import traced_jit
 
-        @jax.jit
-        def step(state, dt):
-            # ghost refresh: density only, like the reference's default
-            # get_mpi_datatype (cell.hpp:46-55)
-            state = {**state, **exchange({"density": state["density"]})}
+        ex_body = self._exchange.raw_body
 
-            rho = state["density"]
-            nbr = t["nbr_rows"]
-            rho_n = gather_neighbors(rho, nbr)           # [D, R, K]
-            vx_n = gather_neighbors(state["vx"], nbr)
-            vy_n = gather_neighbors(state["vy"], nbr)
-            vz_n = gather_neighbors(state["vz"], nbr)
+        def build():
+            def step(rings, t, dev, state, dt):
+                # ghost refresh: density only, like the reference's
+                # default get_mpi_datatype (cell.hpp:46-55)
+                state = {
+                    **state,
+                    **ex_body(*rings, {"density": state["density"]}),
+                }
 
-            sgn = jnp.sign(dev["face_dir"]).astype(rho.dtype)
-            ai = dev["axis_idx"]
-            v_cell = jnp.where(
-                ai == 0, state["vx"][..., None],
-                jnp.where(ai == 1, state["vy"][..., None], state["vz"][..., None]),
-            )
-            v_nbr = jnp.where(ai == 0, vx_n, jnp.where(ai == 1, vy_n, vz_n))
-            cl, nl = dev["cell_axis_len"], dev["nbr_axis_len"]
-            # velocity interpolated to the shared face (solve.hpp:168-175)
-            v_face = (cl * v_nbr + nl * v_cell) / (cl + nl)
+                rho = state["density"]
+                nbr = t["nbr_rows"]
+                rho_n = gather_neighbors(rho, nbr)           # [D, R, K]
+                vx_n = gather_neighbors(state["vx"], nbr)
+                vy_n = gather_neighbors(state["vy"], nbr)
+                vz_n = gather_neighbors(state["vz"], nbr)
 
-            upwind_pos = jnp.where(v_face >= 0, rho[..., None], rho_n)
-            upwind_neg = jnp.where(v_face >= 0, rho_n, rho[..., None])
-            upwind = jnp.where(sgn > 0, upwind_pos, upwind_neg)
-            face_flux = upwind * dt * v_face * dev["min_area"]
-            # +dir face: outflow subtracts; -dir face: adds (solve.hpp:227-233)
-            contrib = jnp.where(dev["face_dir"] != 0, -sgn * face_flux, 0.0)
-            flux = ordered_sum(contrib, axis=-1) * dev["inv_volume"]
+                sgn = jnp.sign(dev["face_dir"]).astype(rho.dtype)
+                ai = dev["axis_idx"]
+                v_cell = jnp.where(
+                    ai == 0, state["vx"][..., None],
+                    jnp.where(ai == 1, state["vy"][..., None],
+                              state["vz"][..., None]),
+                )
+                v_nbr = jnp.where(
+                    ai == 0, vx_n, jnp.where(ai == 1, vy_n, vz_n)
+                )
+                cl, nl = dev["cell_axis_len"], dev["nbr_axis_len"]
+                # velocity interpolated to the shared face
+                # (solve.hpp:168-175)
+                v_face = (cl * v_nbr + nl * v_cell) / (cl + nl)
 
-            local = t["local_mask"]
-            new_rho = jnp.where(local, rho + flux, rho)
-            return {**state, "density": new_rho, "flux": jnp.zeros_like(flux)}
+                upwind_pos = jnp.where(v_face >= 0, rho[..., None], rho_n)
+                upwind_neg = jnp.where(v_face >= 0, rho_n, rho[..., None])
+                upwind = jnp.where(sgn > 0, upwind_pos, upwind_neg)
+                face_flux = upwind * dt * v_face * dev["min_area"]
+                # +dir face: outflow subtracts; -dir face: adds
+                # (solve.hpp:227-233)
+                contrib = jnp.where(
+                    dev["face_dir"] != 0, -sgn * face_flux, 0.0
+                )
+                flux = ordered_sum(contrib, axis=-1) * dev["inv_volume"]
 
-        return step
+                local = t["local_mask"]
+                new_rho = jnp.where(local, rho + flux, rho)
+                return {**state, "density": new_rho,
+                        "flux": jnp.zeros_like(flux)}
+
+            return traced_jit("advection.step", step)
+
+        fn = self.grid.exec_cache.get(self._kernel_key("advection.step"),
+                                      build)
+        self._step_fn = fn
+        rings, t, dev = self._rings, self.tables.tree(), self._dev
+        return lambda state, dt: fn(rings, t, dev, state, dt)
 
     def _build_max_dt(self):
+        from ..parallel.exec_cache import traced_jit
+
+        def build():
+            def max_dt(t, state):
+                # CFL: min over local cells of length/|v| per dim, global
+                # min (solve.hpp:284-330)
+                length = t["length"]
+                steps = jnp.stack(
+                    [
+                        length[..., 0] / jnp.abs(state["vx"]),
+                        length[..., 1] / jnp.abs(state["vy"]),
+                        length[..., 2] / jnp.abs(state["vz"]),
+                    ],
+                    axis=-1,
+                )
+                ok = (jnp.isfinite(steps) & (steps > 0)
+                      & t["local_mask"][..., None])
+                steps = jnp.where(ok, steps, jnp.inf)
+                return jnp.min(steps)
+
+            return traced_jit("advection.max_dt", max_dt)
+
+        fn = self.grid.exec_cache.get(
+            ("advection.max_dt", str(np.dtype(self.dtype))), build
+        )
         t = self.tables.tree()
-
-        @jax.jit
-        def max_dt(state):
-            # CFL: min over local cells of length/|v| per dim, global min
-            # (solve.hpp:284-330)
-            length = t["length"]
-            steps = jnp.stack(
-                [
-                    length[..., 0] / jnp.abs(state["vx"]),
-                    length[..., 1] / jnp.abs(state["vy"]),
-                    length[..., 2] / jnp.abs(state["vz"]),
-                ],
-                axis=-1,
-            )
-            ok = jnp.isfinite(steps) & (steps > 0) & t["local_mask"][..., None]
-            steps = jnp.where(ok, steps, jnp.inf)
-            return jnp.min(steps)
-
-        return max_dt
+        return lambda state: fn(t, state)
 
     def _build_max_diff(self):
-        t = self.tables.tree()
-        dev = self._dev
-        exchange = self._exchange
+        from ..parallel.exec_cache import traced_jit
 
-        @jax.jit
-        def max_diff(state, diff_threshold):
-            """Max relative density difference to face neighbors
-            (adapter.hpp:71-110) — the AMR refinement indicator."""
-            state = {**state, **exchange({"density": state["density"]})}
-            rho = state["density"]
-            rho_n = gather_neighbors(rho, t["nbr_rows"])
-            diff = jnp.abs(rho[..., None] - rho_n) / (
-                jnp.minimum(rho[..., None], rho_n) + diff_threshold
-            )
-            diff = jnp.where(dev["face_dir"] != 0, diff, 0.0)
-            md = diff.max(axis=-1)
-            return {**state, "max_diff": jnp.where(t["local_mask"], md, 0.0)}
+        ex_body = self._exchange.raw_body
 
-        return max_diff
+        def build():
+            def max_diff(rings, t, dev, state, diff_threshold):
+                """Max relative density difference to face neighbors
+                (adapter.hpp:71-110) — the AMR refinement indicator."""
+                state = {
+                    **state,
+                    **ex_body(*rings, {"density": state["density"]}),
+                }
+                rho = state["density"]
+                rho_n = gather_neighbors(rho, t["nbr_rows"])
+                diff = jnp.abs(rho[..., None] - rho_n) / (
+                    jnp.minimum(rho[..., None], rho_n) + diff_threshold
+                )
+                diff = jnp.where(dev["face_dir"] != 0, diff, 0.0)
+                md = diff.max(axis=-1)
+                return {**state,
+                        "max_diff": jnp.where(t["local_mask"], md, 0.0)}
+
+            return traced_jit("advection.max_diff", max_diff)
+
+        fn = self.grid.exec_cache.get(
+            self._kernel_key("advection.max_diff"), build
+        )
+        rings, t, dev = self._rings, self.tables.tree(), self._dev
+        return lambda state, thr: fn(rings, t, dev, state, thr)
 
     # ------------------------------------------------------ boxed AMR path
 
@@ -489,11 +532,41 @@ class Advection:
         dense [D, nzl, ny, nx] z-slab blocks, the halo as two ppermute plane
         transfers, and every face flux as shifted slices that XLA fuses into
         one HBM pass — the layout the reference's per-cell object model
-        cannot express but the one a TPU needs."""
+        cannot express but the one a TPU needs.
+
+        Every compiled artifact is a pure function of (mesh, dims,
+        periodicity, cell size, dtype, pallas mode), so the whole kernel
+        bundle is cached under that key — an adapt cycle that returns to
+        the same uniform shape redispatches the existing executables."""
+        from ..parallel.exec_cache import mesh_key
+
+        info = self.dense
+        l0 = self.grid.geometry.get_level_0_cell_length()
+        self._dx = l0.astype(np.float64)
+        self._vol = float(l0.prod())
+        pallas_mode = (self.use_pallas if isinstance(self.use_pallas, str)
+                       else bool(self.use_pallas))
+        key = (
+            "advection.dense", mesh_key(self.grid.mesh), info.n_devices,
+            info.nz_local, info.ny, info.nx,
+            tuple(bool(p) for p in info.periodic),
+            str(np.dtype(self.dtype)), pallas_mode,
+            tuple(np.asarray(l0, np.float64).tolist()),
+        )
+        bundle = self.grid.exec_cache.get(key, self._build_dense_bundle)
+        self._step = bundle["step"]
+        self._fused_run = bundle["fused_run"]
+        self._dense_run = bundle["dense_run"]
+        self._max_dt = bundle["max_dt"]
+        self._max_diff = bundle["max_diff"]
+        self.dense_kind = bundle["dense_kind"]
+
+    def _build_dense_bundle(self) -> dict:
         from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.dense import HaloExtend
+        from ..parallel.exec_cache import traced_jit
         from ..parallel.mesh import SHARD_AXIS, shard_spec
 
         info = self.dense
@@ -501,10 +574,8 @@ class Advection:
         dtype = self.dtype
         D, nzl, ny, nx = info.n_devices, info.nz_local, info.ny, info.nx
         l0 = grid.geometry.get_level_0_cell_length()
-        self._dx = l0.astype(np.float64)
         area = np.array([l0[1] * l0[2], l0[0] * l0[2], l0[0] * l0[1]])
         vol = float(l0.prod())
-        self._vol = vol
         px, py, pz = info.periodic
         extend = HaloExtend(info)
         mesh = grid.mesh
@@ -559,7 +630,7 @@ class Advection:
         #: which per-step dense kernel engaged — ("blocked_direct", B) /
         #: ("plane",) / ("xla",) — so the bench's HBM-traffic model can
         #: count the bytes the engaged path actually moves
-        self.dense_kind = ("xla",)
+        dense_kind = ("xla",)
         use_pallas = getattr(self, "use_pallas", True)
         # use_pallas="interpret" forces the kernels through the Pallas
         # interpreter so CI (CPU) exercises the full integration path
@@ -571,12 +642,12 @@ class Advection:
                     nzl, ny, nx, step_block, area, 1.0 / vol,
                     interpret=interpret,
                 )
-                self.dense_kind = ("blocked_direct", step_block)
+                dense_kind = ("blocked_direct", step_block)
             elif interpret or flux_update_fits(ny, nx):
                 pallas_update = make_flux_update(
                     nzl, ny, nx, area, 1.0 / vol, interpret=interpret
                 )
-                self.dense_kind = ("plane",)
+                dense_kind = ("plane",)
             if blocked_update is not None or pallas_update is not None:
                 mx3 = jnp.asarray(mask_x, dtype).reshape(1, 1, nx)
                 my3 = jnp.asarray(mask_y, dtype).reshape(1, ny, 1)
@@ -652,12 +723,11 @@ class Advection:
             )
             return {**state, "density": new_rho}
 
-        self._step = step
 
         # Whole-block multi-step kernel (single device, block fits VMEM):
         # the entire run loop executes inside one kernel launch with zero
         # HBM traffic between steps — compute-bound instead of HBM-bound
-        self._fused_run = None
+        fused_run = None
         have_pallas = pallas_update is not None or blocked_update is not None
         if have_pallas and D == 1 and fused_run_fits(nzl, ny, nx):
             fused = make_fused_run(
@@ -674,13 +744,13 @@ class Advection:
                 )
                 return {**state, "density": new_rho[None]}
 
-            self._fused_run = fused_run_fn
+            fused_run = fused_run_fn
 
         # Blocked multi-step run: the whole fori_loop inside one shard_map
         # so the constant vz halo stacks are built once per run call, not
         # once per step (the generic run path re-derives them every
         # iteration because the step body cannot know vz is loop-invariant)
-        self._dense_run = None
+        dense_run = None
         if blocked_update is not None:
 
             def run_body(zf_up, zf_dn, rho, vx, vy, vz, dt, steps):
@@ -714,7 +784,7 @@ class Advection:
                 )
                 return {**state, "density": new_rho}
 
-            self._dense_run = dense_run_fn
+            dense_run = dense_run_fn
 
         dx = self._dx
 
@@ -731,7 +801,6 @@ class Advection:
             s = jnp.where(jnp.isfinite(s) & (s > 0), s, jnp.inf)
             return jnp.min(s)
 
-        self._max_dt = max_dt
 
         # AMR refinement indicator on the dense layout (adapter.hpp:71-110
         # runs on the same data the solver uses — so does this): max
@@ -774,7 +843,14 @@ class Advection:
             )
             return {**state, "max_diff": md}
 
-        self._max_diff = dense_max_diff
+        return {
+            "step": step,
+            "fused_run": fused_run,
+            "dense_run": dense_run,
+            "max_dt": max_dt,
+            "max_diff": dense_max_diff,
+            "dense_kind": dense_kind,
+        }
 
     def _dense_to_rows(self, state):
         """Dense [D, nzl, ny, nx] state -> general [D, R] row-layout state
@@ -936,13 +1012,40 @@ class Advection:
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if not hasattr(self, "_run"):
-            inner = self._step
+            if hasattr(self, "_step_fn"):
+                from ..parallel.exec_cache import traced_jit
 
-            @jax.jit
-            def run_fn(state, steps, dt):
-                return jax.lax.fori_loop(0, steps, lambda i, st: inner(st, dt), state)
+                inner = self._step_fn
 
-            self._run = run_fn
+                def build():
+                    def run_fn(rings, t, dev, state, steps, dt):
+                        return jax.lax.fori_loop(
+                            0, steps,
+                            lambda i, st: inner(rings, t, dev, st, dt),
+                            state,
+                        )
+
+                    return traced_jit("advection.run", run_fn)
+
+                fn = self.grid.exec_cache.get(
+                    self._kernel_key("advection.run"), build
+                )
+                rings, t, dev = self._rings, self.tables.tree(), self._dev
+                self._run = lambda state, steps, dt: fn(
+                    rings, t, dev, state, steps, dt
+                )
+            else:
+                # dense XLA-only path: the step came from the cached
+                # dense bundle (plain (state, dt) signature)
+                inner = self._step
+
+                @jax.jit
+                def run_fn(state, steps, dt):
+                    return jax.lax.fori_loop(
+                        0, steps, lambda i, st: inner(st, dt), state
+                    )
+
+                self._run = run_fn
         self._record_run("general", steps, state)
         return self._run(state, steps, jnp.asarray(dt, self.dtype))
 

@@ -89,26 +89,38 @@ class GameOfLife:
         return state
 
     def _build_step(self):
+        from ..parallel.exec_cache import traced_jit
+
+        ex = self._exchange
+        ex_body = ex.raw_body
+        rings = tuple(ex.ring_send) + tuple(ex.ring_recv)
+
+        def build():
+            def step(rings, tables, state):
+                state = ex_body(*rings, state)
+                alive = state["is_alive"]
+                nbr_alive = gather_neighbors(
+                    alive, tables["nbr_rows"]
+                )                                                   # [D,R,K]
+                count = jnp.sum(
+                    jnp.where(tables["nbr_valid"],
+                              (nbr_alive > 0).astype(jnp.uint32), 0),
+                    axis=-1,
+                )
+                new_alive = _life_rule(count, alive)
+                local = tables["local_mask"]
+                return {
+                    "is_alive": jnp.where(local, new_alive, alive),
+                    "live_neighbor_count": jnp.where(
+                        local, count, jnp.uint32(0)
+                    ),
+                }
+
+            return traced_jit("gol.step", step)
+
+        fn = self.grid.exec_cache.get(("gol.step", ex.structure_key), build)
         tables = self.tables.tree()
-        exchange = self._exchange
-
-        @jax.jit
-        def step(state):
-            state = exchange(state)
-            alive = state["is_alive"]
-            nbr_alive = gather_neighbors(alive, tables["nbr_rows"])     # [D,R,K]
-            count = jnp.sum(
-                jnp.where(tables["nbr_valid"], (nbr_alive > 0).astype(jnp.uint32), 0),
-                axis=-1,
-            )
-            new_alive = _life_rule(count, alive)
-            local = tables["local_mask"]
-            return {
-                "is_alive": jnp.where(local, new_alive, alive),
-                "live_neighbor_count": jnp.where(local, count, jnp.uint32(0)),
-            }
-
-        return step
+        return lambda state: fn(rings, tables, state)
 
     def _build_overlap_step(self):
         """Split-phase step: collective and inner compute are dataflow-
@@ -135,61 +147,79 @@ class GameOfLife:
         put = lambda a: put_table(a, mesh)
         tabs = tuple(put(a) for a in (irows, orows, nri, nvi, nro, nvo))
         local = put(epoch.local_mask)
-        nk = len(halo.ring_ks)
-        perms = halo.ring_perms
-        data_spec = P(SHARD_AXIS)
+        rings = tuple(halo.ring_send) + tuple(halo.ring_recv)
+        ks = tuple(halo.ring_ks)
 
-        rule = _life_rule
-
+        from ..parallel.exec_cache import traced_jit
         from ..parallel.halo import HaloExchange
 
-        def body(*args):
-            # args: ring send tabs (nk), ring recv tabs (nk), then the
-            # compute tables and the alive array
-            sends = [a[0] for a in args[:nk]]
-            recvs = [a[0] for a in args[nk:2 * nk]]
-            irows, orows, nri, nvi, nro, nvo, local, alive = args[2 * nk:]
-            a = alive[0]                                     # [R]
-            # --- start: ghost payload collectives (depend only on `a`)
-            payloads = HaloExchange.ring_start(a, perms, sends)
-            # --- inner compute: no remote neighbors, no dep on payloads
-            cnt_i = jnp.sum(
-                jnp.where(nvi[0], (a[nri[0]] > 0).astype(jnp.uint32), 0),
-                -1, dtype=jnp.uint32,
-            )
-            new_i = rule(cnt_i, a[irows[0]])
-            # --- wait: merging the payloads IS the synchronization
-            a2 = HaloExchange.ring_finish(a, recvs, payloads)
-            # --- outer compute: needs fresh ghosts
-            cnt_o = jnp.sum(
-                jnp.where(nvo[0], (a2[nro[0]] > 0).astype(jnp.uint32), 0),
-                -1, dtype=jnp.uint32,
-            )
-            new_o = rule(cnt_o, a2[orows[0]])
-            out_a = a2.at[irows[0]].set(new_i).at[orows[0]].set(new_o)
-            out_a = jnp.where(local[0], out_a, a2)           # clean scratch
-            cnt = (
-                jnp.zeros_like(a).at[irows[0]].set(cnt_i).at[orows[0]].set(cnt_o)
-            )
-            cnt = jnp.where(local[0], cnt, jnp.uint32(0))
-            return out_a[None], cnt[None]
+        def build():
+            nk = len(ks)
+            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+            data_spec = P(SHARD_AXIS)
+            rule = _life_rule
 
-        fn = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(P(SHARD_AXIS, None),) * (2 * nk)
-            + (P(SHARD_AXIS, None),) * 2
-            + (P(SHARD_AXIS, None, None),) * 4 + (P(SHARD_AXIS, None), data_spec),
-            out_specs=(data_spec, data_spec),
-            check_vma=False,
+            def body(*args):
+                # args: ring send tabs (nk), ring recv tabs (nk), then
+                # the compute tables and the alive array
+                sends = [a[0] for a in args[:nk]]
+                recvs = [a[0] for a in args[nk:2 * nk]]
+                irows, orows, nri, nvi, nro, nvo, local, alive = (
+                    args[2 * nk:]
+                )
+                a = alive[0]                                     # [R]
+                # --- start: ghost payload collectives (depend on `a`)
+                payloads = HaloExchange.ring_start(a, perms, sends)
+                # --- inner compute: no remote neighbors, no dep on
+                # payloads
+                cnt_i = jnp.sum(
+                    jnp.where(nvi[0], (a[nri[0]] > 0).astype(jnp.uint32),
+                              0),
+                    -1, dtype=jnp.uint32,
+                )
+                new_i = rule(cnt_i, a[irows[0]])
+                # --- wait: merging the payloads IS the synchronization
+                a2 = HaloExchange.ring_finish(a, recvs, payloads)
+                # --- outer compute: needs fresh ghosts
+                cnt_o = jnp.sum(
+                    jnp.where(nvo[0],
+                              (a2[nro[0]] > 0).astype(jnp.uint32), 0),
+                    -1, dtype=jnp.uint32,
+                )
+                new_o = rule(cnt_o, a2[orows[0]])
+                out_a = a2.at[irows[0]].set(new_i).at[orows[0]].set(new_o)
+                out_a = jnp.where(local[0], out_a, a2)   # clean scratch
+                cnt = (
+                    jnp.zeros_like(a)
+                    .at[irows[0]].set(cnt_i).at[orows[0]].set(cnt_o)
+                )
+                cnt = jnp.where(local[0], cnt, jnp.uint32(0))
+                return out_a[None], cnt[None]
+
+            fn = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None),) * (2 * nk)
+                + (P(SHARD_AXIS, None),) * 2
+                + (P(SHARD_AXIS, None, None),) * 4
+                + (P(SHARD_AXIS, None), data_spec),
+                out_specs=(data_spec, data_spec),
+                check_vma=False,
+            )
+
+            def step(rings, tabs, local, alive):
+                return fn(*rings, *tabs, local, alive)
+
+            return traced_jit("gol.overlap_step", step)
+
+        from ..parallel.exec_cache import mesh_key
+
+        fn = self.grid.exec_cache.get(
+            ("gol.overlap_step", mesh_key(mesh), D, ks), build
         )
 
-        @jax.jit
         def step(state):
-            out_a, cnt = fn(
-                *halo.ring_send, *halo.ring_recv, *tabs, local,
-                state["is_alive"],
-            )
+            out_a, cnt = fn(rings, tabs, local, state["is_alive"])
             return {"is_alive": out_a, "live_neighbor_count": cnt}
 
         return step
@@ -200,7 +230,24 @@ class GameOfLife:
         halo two ppermuted boundary rows — one dispatch for any number of
         turns (the reference's scalability configuration,
         ``tests/game_of_life/scalability.cpp``, without its per-turn
-        message machinery)."""
+        message machinery).
+
+        The bundle is a pure function of (mesh, dims, periodicity,
+        pallas mode), so it is cached under that key and survives
+        rebuilds that return to the same uniform shape."""
+        from ..parallel.exec_cache import mesh_key
+
+        info = self.dense2d
+        pallas_mode = (self.use_pallas if isinstance(self.use_pallas, str)
+                       else bool(self.use_pallas))
+        key = ("gol.dense", mesh_key(self.grid.mesh), info["D"],
+               info["nyl"], info["nx"],
+               tuple(bool(p) for p in info["periodic"]), pallas_mode)
+        fused, run = self.grid.exec_cache.get(key, self._build_dense_bundle)
+        self._fused_run = fused
+        return run
+
+    def _build_dense_bundle(self):
         from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -219,6 +266,7 @@ class GameOfLife:
         from ..ops.gol_kernel import gol_run_fits, make_gol_run
 
         interpret = self.use_pallas == "interpret"
+        fused_run = None
         if (
             self.use_pallas
             and have_pallas()
@@ -263,7 +311,7 @@ class GameOfLife:
             # the Pallas kernel is an optimization over the XLA dense
             # loop built below — keep both so a TPU-generation Mosaic
             # rejection at first call can fall back (see run())
-            self._fused_run = fused_fn
+            fused_run = fused_fn
         # x-wrap validity columns: neighbor at x+1 invalid for x = nx-1 on
         # open x; at x-1 invalid for x = 0
         vx_hi = np.ones(nx, np.uint32)
@@ -319,7 +367,7 @@ class GameOfLife:
             out_a, cnt = fn(state["is_alive"], turns)
             return {"is_alive": out_a, "live_neighbor_count": cnt}
 
-        return run_fn
+        return fused_run, run_fn
 
     def _disable_fused(self):
         self._fused_run = None

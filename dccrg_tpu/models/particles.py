@@ -109,22 +109,29 @@ class Particles:
     # ---------------------------------------------------------------- step
 
     def _build_push(self):
+        from ..parallel.exec_cache import traced_jit
+
+        def build():
+            def push(local, state, velocity, dt):
+                P = state["particles"].shape[2]
+                slot = jnp.arange(P)[None, None, :]
+                valid = slot < state["number_of_particles"][..., None]
+                v = jnp.asarray(velocity)
+                if v.ndim == 3:          # per-cell field [D, R, 3]
+                    v = v[:, :, None, :]
+                moved = state["particles"] + v * dt
+                new = jnp.where(
+                    (valid & local[..., None])[..., None], moved,
+                    state["particles"],
+                )
+                return {**state, "particles": new}
+
+            return traced_jit("particles.push", push)
+
+        fn = self.grid.exec_cache.get(("particles.push",), build)
         local = self.tables.local_mask
-
-        @jax.jit
-        def push(state, velocity, dt):
-            slot = jnp.arange(self.P)[None, None, :]
-            valid = slot < state["number_of_particles"][..., None]
-            v = jnp.asarray(velocity)
-            if v.ndim == 3:          # per-cell field [D, R, 3]
-                v = v[:, :, None, :]
-            moved = state["particles"] + v * dt
-            new = jnp.where(
-                (valid & local[..., None])[..., None], moved, state["particles"]
-            )
-            return {**state, "particles": new}
-
-        return push
+        self._push_fn, self._push_args = fn, (local,)
+        return lambda state, velocity, dt: fn(local, state, velocity, dt)
 
     # --------------------------------------------- device-side re-bucketing
 
@@ -205,6 +212,7 @@ class Particles:
         def body(pos, cnt, ids_s, rows_s, local):
             pos, cnt = pos[0], cnt[0]                 # [R,P,3], [R]
             ids_s, rows_s, local = ids_s[0], rows_s[0], local[0]
+            R, P = pos.shape[0], pos.shape[1]
             dt_ = pos.dtype
             valid = (jnp.arange(P)[None, :] < cnt[:, None]).reshape(-1)
             p = pos.reshape(R * P, 3)
@@ -266,31 +274,51 @@ class Particles:
             )
             return new_pos[None], new_cnt[None], before - after
 
-        fn = shard_map(
-            body,
-            mesh=grid.mesh,
-            in_specs=(Pspec(SHARD_AXIS),) * 5,
-            out_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec()),
-            check_vma=False,
+        from ..parallel.exec_cache import mesh_key, traced_jit
+
+        def build():
+            fn = shard_map(
+                body,
+                mesh=grid.mesh,
+                in_specs=(Pspec(SHARD_AXIS),) * 5,
+                out_specs=(Pspec(SHARD_AXIS), Pspec(SHARD_AXIS), Pspec()),
+                check_vma=False,
+            )
+
+            def rebucket_fn(ids_arr, rows_arr, local_arr, state):
+                new_pos, new_cnt, lost = fn(
+                    state["particles"], state["number_of_particles"],
+                    ids_arr, rows_arr, local_arr,
+                )
+                return {
+                    **state,
+                    "particles": new_pos,
+                    "number_of_particles": new_cnt,
+                    "overflow": state.get("overflow", jnp.int32(0)) + lost,
+                }
+
+            return traced_jit("particles.rebucket", rebucket_fn)
+
+        # every constant baked into the body's trace (voxel metrics,
+        # level offsets, periodicity, the present refinement levels) is
+        # pinned by this key; the sorted row-id tables enter as runtime
+        # arguments, so churn that keeps the key re-dispatches the
+        # compiled program
+        key = (
+            "particles.rebucket", mesh_key(grid.mesh), D,
+            str(np.dtype(id_dtype)), L, (nx, ny, nz),
+            tuple(np.asarray(start, np.float64).tolist()),
+            tuple(np.asarray(clen0, np.float64).tolist()),
+            tuple(bool(p) for p in periodic), tuple(levels_present),
         )
+        fn = self.grid.exec_cache.get(key, build)
         ids_arr = put_table(ids_sorted, grid.mesh, id_dtype)
         rows_arr = put_table(rows_sorted, grid.mesh, jnp.int32)
         local_arr = put_table(local_rows, grid.mesh, bool)
-
-        @jax.jit
-        def rebucket_fn(state):
-            new_pos, new_cnt, lost = fn(
-                state["particles"], state["number_of_particles"],
-                ids_arr, rows_arr, local_arr,
-            )
-            return {
-                **state,
-                "particles": new_pos,
-                "number_of_particles": new_cnt,
-                "overflow": state.get("overflow", jnp.int32(0)) + lost,
-            }
-
-        return rebucket_fn
+        self._rebucket_fn = fn
+        self._rebucket_key = key
+        self._rebucket_args = (ids_arr, rows_arr, local_arr)
+        return lambda state: fn(ids_arr, rows_arr, local_arr, state)
 
     def velocity_field(self, fn) -> np.ndarray:
         """Per-cell velocity array ``[D, R, 3]`` from a function of cell
@@ -328,21 +356,40 @@ class Particles:
                 state = self.step(state, velocity, dt)
             return state
         if not hasattr(self, "_run"):
-            exchange, push, rebucket = self._exchange, self._push, self._dev_rebucket
+            from ..parallel.exec_cache import traced_jit
 
-            @jax.jit
-            def run_fn(state, steps, velocity, dt):
-                def one(_, st):
-                    st = push(st, velocity, dt)
-                    st = {**st, **exchange(
-                        {"number_of_particles": st["number_of_particles"]}
-                    )}
-                    st = {**st, **exchange({"particles": st["particles"]})}
-                    return rebucket(st)
+            ex = self._exchange
+            ex_body = ex.raw_body
+            rings = tuple(ex.ring_send) + tuple(ex.ring_recv)
+            push_fn, rebucket_fn = self._push_fn, self._rebucket_fn
 
-                return jax.lax.fori_loop(0, steps, one, state)
+            def build():
+                def run_fn(rings, local, rb_args, state, steps,
+                           velocity, dt):
+                    def one(_, st):
+                        st = push_fn(local, st, velocity, dt)
+                        st = {**st, **ex_body(*rings, {
+                            "number_of_particles":
+                                st["number_of_particles"],
+                        })}
+                        st = {**st, **ex_body(
+                            *rings, {"particles": st["particles"]}
+                        )}
+                        return rebucket_fn(*rb_args, st)
 
-            self._run = run_fn
+                    return jax.lax.fori_loop(0, steps, one, state)
+
+                return traced_jit("particles.run", run_fn)
+
+            fn = self.grid.exec_cache.get(
+                ("particles.run", ex.structure_key, self._rebucket_key),
+                build,
+            )
+            rb_args = self._rebucket_args
+            local = self._push_args[0]
+            self._run = lambda state, steps, velocity, dt: fn(
+                rings, local, rb_args, state, steps, velocity, dt
+            )
         state = {**state, "overflow": state.get("overflow", jnp.int32(0))}
         return self._run(
             state, jnp.asarray(steps, jnp.int32),

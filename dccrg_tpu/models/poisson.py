@@ -330,7 +330,13 @@ class Poisson:
     def _build_solver(self):
         """The BiCG loop, built over one of two operator spaces: the
         general gather tables ([1, R] rows) or the flat voxel grid when
-        it qualifies — same algorithm, same stopping rules."""
+        it qualifies — same algorithm, same stopping rules.  The plain
+        gather-table form (no flat layout, no rolled decomposition — the
+        AMR-churn shape) is pulled from the grid's executable cache with
+        every table as a runtime argument, so rebuilds with the same
+        shape signature never recompile the solve loop."""
+        if self._flat is None and self._rolled is None:
+            return self._build_gather_solver()
         local = self.tables.local_mask
         if self._flat is not None:
             apply_fwd, apply_rev, voxelize, writeback, masks = self._flat
@@ -411,6 +417,97 @@ class Poisson:
             return {**state, "solution": sol}, best_res, i
 
         return solve
+
+    def _build_gather_solver(self):
+        """The cached-executable form of the gather-table BiCG solve:
+        identical algorithm to :meth:`_build_solver`'s gather branch,
+        with the halo schedule, gather table, masks and multiplier
+        tables entering as jit arguments."""
+        from ..parallel.exec_cache import traced_jit
+
+        ex = self._exchange
+        ex_body = ex.raw_body
+        rings = tuple(ex.ring_send) + tuple(ex.ring_recv)
+
+        def build():
+            def solve(rings, nbr_rows, local, solve_mask, scaling,
+                      mult_fwd, mult_rev, state, max_iterations,
+                      stop_residual, stop_after_increase):
+                def apply_mult(v, mult):
+                    v = ex_body(*rings, {"v": v})["v"]
+                    vn = gather_neighbors(v, nbr_rows)
+                    return scaling * v + ordered_sum(mult * vn, axis=-1)
+
+                def dot(a, b):
+                    return jnp.sum(jnp.where(solve_mask, a * b, 0.0))
+
+                def lift(row_arr):
+                    # boundary cells keep their given solution values:
+                    # they feed the initial residual (Dirichlet lifting)
+                    # but never change
+                    return jnp.where(local, row_arr, 0.0)
+
+                rhs = jnp.where(solve_mask, lift(state["rhs"]), 0.0)
+                x = lift(state["solution"])
+
+                Ax = apply_mult(x, mult_fwd)
+                r0 = jnp.where(solve_mask, rhs - Ax, 0.0)
+                r1 = r0
+                p0, p1 = r0, r1
+                dot_r = dot(r0, r1)
+                res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+
+                def cond(carry):
+                    (i, x, r0, r1, p0, p1, dot_r, res, best_res,
+                     best_x) = carry
+                    return (
+                        (i < max_iterations)
+                        & (res > stop_residual)
+                        & (dot_r != 0)
+                        & (res <= best_res * stop_after_increase)
+                    )
+
+                def body(carry):
+                    i, x, r0, r1, p0, p1, dot_r, _, best_res, best_x = carry
+                    Ap0 = jnp.where(
+                        solve_mask, apply_mult(p0, mult_fwd), 0.0
+                    )
+                    ATp1 = jnp.where(
+                        solve_mask, apply_mult(p1, mult_rev), 0.0
+                    )
+                    dot_p = dot(p1, Ap0)
+                    alpha = jnp.where(dot_p != 0, dot_r / dot_p, 0.0)
+                    x = x + alpha * p0
+                    r0 = r0 - alpha * Ap0
+                    r1 = r1 - alpha * ATp1
+                    new_dot_r = dot(r0, r1)
+                    beta = jnp.where(dot_r != 0, new_dot_r / dot_r, 0.0)
+                    p0 = r0 + beta * p0
+                    p1 = r1 + beta * p1
+                    res = jnp.sqrt(jnp.abs(dot(r0, r0)))
+                    better = res < best_res
+                    best_res = jnp.where(better, res, best_res)
+                    best_x = jnp.where(better, x, best_x)
+                    return (i + 1, x, r0, r1, p0, p1, new_dot_r, res,
+                            best_res, best_x)
+
+                carry = (jnp.int32(0), x, r0, r1, p0, p1, dot_r, res0,
+                         res0, x)
+                (i, x, r0, r1, p0, p1, dot_r, res, best_res,
+                 best_x) = jax.lax.while_loop(cond, body, carry)
+                sol = jnp.where(local, best_x, 0.0)
+                return {**state, "solution": sol}, best_res, i
+
+            return traced_jit("poisson.solve", solve)
+
+        fn = self.grid.exec_cache.get(
+            ("poisson.solve", ex.structure_key, str(np.dtype(self.dtype))),
+            build,
+        )
+        mult_fwd, mult_rev = self._mult_tables()
+        args = (rings, self.tables.nbr_rows, self.tables.local_mask,
+                self._solve_mask, self._scaling, mult_fwd, mult_rev)
+        return lambda state, mi, sr, si: fn(*args, state, mi, sr, si)
 
     def _build_fast_solver(self):
         """Whole-solve fused BiCG kernel (ops/poisson_kernel.py): the

@@ -48,6 +48,7 @@ class Vlasov:
         self.grid = grid
         self.info = grid.epoch.dense
         self.nv = nv
+        self.v_max = float(v_max)
         self.B = nv**3
         self.dtype = dtype
         self.use_pallas = use_pallas
@@ -71,6 +72,28 @@ class Vlasov:
     # ------------------------------------------------------------- kernels
 
     def _build_step(self):
+        """Dense-layout kernels, cached as one bundle: every compiled
+        artifact is a pure function of (mesh, dims, periodicity, cell
+        size, velocity grid, dtype, pallas mode)."""
+        from ..parallel.exec_cache import mesh_key
+
+        info = self.info
+        l0 = self.grid.geometry.get_level_0_cell_length()
+        pallas_mode = (self.use_pallas if isinstance(self.use_pallas, str)
+                       else bool(self.use_pallas))
+        key = (
+            "vlasov.dense", mesh_key(self.grid.mesh), info.n_devices,
+            info.nz_local, info.ny, info.nx, self.nv, self.v_max,
+            tuple(bool(p) for p in info.periodic),
+            str(np.dtype(self.dtype)), pallas_mode,
+            tuple(np.asarray(l0, np.float64).tolist()),
+        )
+        bundle = self.grid.exec_cache.get(key, self._build_dense_bundle)
+        self._fused_block = bundle["fused_block"]
+        self._step_xla, self._run_xla = bundle["step_xla"], bundle["run_xla"]
+        self._step, self._run = bundle["step"], bundle["run"]
+
+    def _build_dense_bundle(self) -> dict:
         from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -123,7 +146,7 @@ class Vlasov:
         # optimization layered over the always-built XLA step: a Mosaic
         # rejection at first call disables it for the instance (the
         # flat-AMR / fused-GoL fallback pattern)
-        self._fused_block = 0
+        fused_block = 0
         from ..ops.dense_advection import have_pallas, pallas_available
         from ..ops.vlasov_kernel import (
             make_vlasov_step_blocked,
@@ -141,7 +164,7 @@ class Vlasov:
             and blk
             and (interpret or pallas_available(np.float32))
         ):
-            self._fused_block = blk
+            fused_block = blk
             kern = make_vlasov_step_blocked(
                 nzl, ny, nx, B, inv_dx, periodic, block=blk,
                 interpret=interpret,
@@ -185,11 +208,18 @@ class Vlasov:
 
             return step, run
 
-        self._step_xla, self._run_xla = make_pair(body)
+        step_xla, run_xla = make_pair(body)
         if body_fast is not None:
-            self._step, self._run = make_pair(body_fast)
+            step_fast, run_fast = make_pair(body_fast)
         else:
-            self._step, self._run = self._step_xla, self._run_xla
+            step_fast, run_fast = step_xla, run_xla
+        return {
+            "fused_block": fused_block,
+            "step_xla": step_xla,
+            "run_xla": run_xla,
+            "step": step_fast,
+            "run": run_fast,
+        }
 
     def _disable_fused(self):
         self._fused_block = 0
@@ -221,8 +251,6 @@ class Vlasov:
         self._exchange = grid.halo(None)
         _host, dev = build_face_tables(grid, None, self.tables, dtype)
         t = self.tables.tree()
-        exchange = self._exchange
-        vbT = jnp.asarray(self.v_bins.T, dtype)      # [3, B]
 
         # open-boundary face areas per cell per axis/side: the dense
         # path's vacuum-inflow/free-outflow closure (zero incoming, full
@@ -253,49 +281,77 @@ class Vlasov:
         has_open = bool(bnd_pos.any() or bnd_neg.any())
         # one (D, R) table per axis/side: put_table shards the leading
         # (device) axis
-        bnd_pos_dev = [put_table(bnd_pos[d3], grid.mesh, dtype)
-                       for d3 in range(3)]
-        bnd_neg_dev = [put_table(bnd_neg[d3], grid.mesh, dtype)
-                       for d3 in range(3)]
+        bnd_pos_dev = tuple(put_table(bnd_pos[d3], grid.mesh, dtype)
+                            for d3 in range(3))
+        bnd_neg_dev = tuple(put_table(bnd_neg[d3], grid.mesh, dtype)
+                            for d3 in range(3))
 
-        @jax.jit
-        def step(state, dt):
-            state = {**state, **exchange({"f": state["f"]})}
-            f = state["f"]                            # [D, R, B]
-            f_n = gather_neighbors(f, t["nbr_rows"])  # [D, R, K, B]
-            sgn = jnp.sign(dev["face_dir"]).astype(f.dtype)[..., None]
-            ai = dev["axis_idx"].astype(jnp.int32)    # [D, R, K]
-            v_face = vbT[ai]                          # [D, R, K, B]
-            f_c = f[:, :, None, :]
-            up_pos = jnp.where(v_face >= 0, f_c, f_n)
-            up_neg = jnp.where(v_face >= 0, f_n, f_c)
-            upwind = jnp.where(sgn > 0, up_pos, up_neg)
-            face_flux = upwind * (dt * v_face) * dev["min_area"][..., None]
-            contrib = jnp.where(
-                (dev["face_dir"] != 0)[..., None], -sgn * face_flux, 0.0
-            )
-            total = ordered_sum(contrib, axis=-2)
-            if has_open:
-                # outgoing-only boundary faces (incoming is vacuum)
-                rate = sum(
-                    bnd_pos_dev[d3][..., None] * jnp.maximum(vbT[d3], 0)
-                    + bnd_neg_dev[d3][..., None] * jnp.maximum(-vbT[d3], 0)
-                    for d3 in range(3)
+        from ..parallel.exec_cache import traced_jit
+
+        ex = self._exchange
+        ex_body = ex.raw_body
+        rings = tuple(ex.ring_send) + tuple(ex.ring_recv)
+
+        def build():
+            def step(rings, t, dev, vbT, bnd_pos_dev, bnd_neg_dev,
+                     state, dt):
+                state = {**state, **ex_body(*rings, {"f": state["f"]})}
+                f = state["f"]                            # [D, R, B]
+                f_n = gather_neighbors(f, t["nbr_rows"])  # [D, R, K, B]
+                sgn = jnp.sign(dev["face_dir"]).astype(f.dtype)[..., None]
+                ai = dev["axis_idx"].astype(jnp.int32)    # [D, R, K]
+                v_face = vbT[ai]                          # [D, R, K, B]
+                f_c = f[:, :, None, :]
+                up_pos = jnp.where(v_face >= 0, f_c, f_n)
+                up_neg = jnp.where(v_face >= 0, f_n, f_c)
+                upwind = jnp.where(sgn > 0, up_pos, up_neg)
+                face_flux = (upwind * (dt * v_face)
+                             * dev["min_area"][..., None])
+                contrib = jnp.where(
+                    (dev["face_dir"] != 0)[..., None], -sgn * face_flux,
+                    0.0,
                 )
-                total = total - dt * f * rate
-            flux = total * dev["inv_volume"][..., None]
-            local = t["local_mask"][..., None]
-            return {**state, "f": jnp.where(local, f + flux, f)}
+                total = ordered_sum(contrib, axis=-2)
+                if has_open:
+                    # outgoing-only boundary faces (incoming is vacuum)
+                    rate = sum(
+                        bnd_pos_dev[d3][..., None]
+                        * jnp.maximum(vbT[d3], 0)
+                        + bnd_neg_dev[d3][..., None]
+                        * jnp.maximum(-vbT[d3], 0)
+                        for d3 in range(3)
+                    )
+                    total = total - dt * f * rate
+                flux = total * dev["inv_volume"][..., None]
+                local = t["local_mask"][..., None]
+                return {**state, "f": jnp.where(local, f + flux, f)}
 
-        @jax.jit
-        def run(state, steps, dt):
-            dt_ = jnp.asarray(dt, dtype)
-            return jax.lax.fori_loop(
-                0, steps, lambda i, st: step(st, dt_), state
-            )
+            step_k = traced_jit("vlasov.step", step)
 
-        self._step = self._step_xla = step
-        self._run = self._run_xla = run
+            def run(rings, t, dev, vbT, bnd_pos_dev, bnd_neg_dev,
+                    state, steps, dt):
+                dt_ = jnp.asarray(dt, dtype)
+                return jax.lax.fori_loop(
+                    0, steps,
+                    lambda i, st: step_k(rings, t, dev, vbT, bnd_pos_dev,
+                                         bnd_neg_dev, st, dt_),
+                    state,
+                )
+
+            return step_k, traced_jit("vlasov.run", run)
+
+        step_fn, run_fn = self.grid.exec_cache.get(
+            ("vlasov.step", ex.structure_key, str(np.dtype(dtype)),
+             has_open), build
+        )
+        vbT = jnp.asarray(self.v_bins.T, dtype)
+        args = (rings, t, dev, vbT, bnd_pos_dev, bnd_neg_dev)
+        self._step = self._step_xla = (
+            lambda state, dt: step_fn(*args, state, dt)
+        )
+        self._run = self._run_xla = (
+            lambda state, steps, dt: run_fn(*args, state, steps, dt)
+        )
 
     # ------------------------------------------------------------ user API
 

@@ -23,6 +23,7 @@ from ..core.mapping import Mapping
 from ..core.topology import Topology
 from ..core.neighbors import LeafSet, NeighborLists, find_all_neighbors, invert_neighbors
 from .dense import detect_dense
+from .shapes import bucket_k, bucket_rows
 
 __all__ = ["HoodState", "Epoch", "build_epoch"]
 
@@ -161,6 +162,7 @@ def build_epoch(
     neighborhoods: dict,
     *,
     uniform_geometry: bool,
+    shape_hints: dict | None = None,
 ) -> Epoch:
     """Build the complete derived state for a (leaves, owner) snapshot.
 
@@ -173,6 +175,12 @@ def build_epoch(
     which is only meaningful then — a stretched geometry must not
     qualify.
 
+    ``shape_hints``: the pre-change epoch's ``{"R": ..., "K": {hood:
+    ...}}`` (``shapes.epoch_shape_hints``) — bucket hysteresis keeps
+    those shapes while utilization allows, so compiled schedules keyed
+    by shape survive the rebuild.  Builds handed no hints produce the
+    deterministic natural buckets.
+
     Telemetry: the whole build is the ``epoch.build`` phase (per-hood
     neighbor searches under ``epoch.hood_build``); the resulting table
     shapes land as ``epoch.*`` gauges.
@@ -182,11 +190,15 @@ def build_epoch(
     with metrics.phase("epoch.build"):
         epoch = _build_epoch_impl(
             mapping, topology, leaves, n_devices, neighborhoods,
-            uniform_geometry=uniform_geometry,
+            uniform_geometry=uniform_geometry, shape_hints=shape_hints,
         )
     if metrics.enabled:
         metrics.gauge("epoch.n_cells", len(epoch.leaves))
         metrics.gauge("epoch.rows_per_device", epoch.R)
+        metrics.gauge("epoch.bucket_R", epoch.R)
+        for hid, h in epoch.hoods.items():
+            metrics.gauge("epoch.bucket_K", h.nbr_rows.shape[2],
+                          hood="default" if hid is None else str(hid))
         metrics.gauge("epoch.ghost_cells", int(epoch.n_ghost.sum()))
         metrics.gauge("epoch.hoods", len(epoch.hoods))
         # send/recv schedule size: cells exchanged per full halo update,
@@ -210,8 +222,11 @@ def _build_epoch_impl(
     neighborhoods: dict,
     *,
     uniform_geometry: bool,
+    shape_hints: dict | None = None,
 ) -> Epoch:
     from ..obs import metrics
+
+    hints = shape_hints or {}
 
     N = len(leaves)
     D = n_devices
@@ -237,7 +252,8 @@ def _build_epoch_impl(
         pairs = np.zeros((0, 2), dtype=np.int64)
 
     # --- row layout
-    epoch, len_all = _row_layout(mapping, topology, leaves, D, pairs)
+    epoch, len_all = _row_layout(mapping, topology, leaves, D, pairs,
+                                 prev_R=hints.get("R"))
 
     # --- pass 2: per-hood device tables + schedules
     for hid, (offsets, lists, to_start, to_src, h_pairs, is_outer) in (
@@ -245,7 +261,7 @@ def _build_epoch_impl(
     ):
         epoch.hoods[hid] = _finish_hood(
             epoch, offsets, lists, to_start, to_src, h_pairs, len_all,
-            is_outer,
+            is_outer, prev_K=hints.get("K", {}).get(hid),
         )
     epoch.dense = (
         detect_dense(mapping, topology, leaves, D)
@@ -260,11 +276,17 @@ def _row_layout(
     leaves: LeafSet,
     n_devices: int,
     pairs: np.ndarray,
+    prev_R: int | None = None,
 ) -> tuple[Epoch, np.ndarray]:
     """Row layout + per-row cell tables for a (leaves, ghost pairs)
     snapshot: the hood-independent part of an epoch, shared by the full
     build and the incremental delta path.  Returns ``(epoch, len_all)``
-    with ``epoch.hoods`` still empty."""
+    with ``epoch.hoods`` still empty.
+
+    ``R`` is rounded up the geometric bucket ladder (``shapes.py``) so
+    small growth/shrink keeps the payload shape — extra rows are
+    ordinary pad rows (the same invariants as the inter-device padding
+    that always existed below the widest device's row count)."""
     N = len(leaves)
     D = n_devices
     owner = leaves.owner.astype(np.int64)
@@ -274,6 +296,7 @@ def _row_layout(
     n_local = np.array([len(p) for p in local_pos], dtype=np.int64)
     n_ghost = np.array([len(p) for p in ghost_pos], dtype=np.int64)
     R = int((n_local + n_ghost).max()) + 1 if N else 1
+    R = bucket_rows(R, prev_R)
 
     row_of = np.zeros(N, dtype=np.int64)
     for d in range(D):
@@ -374,6 +397,7 @@ def _finish_hood(
     pairs: np.ndarray,
     len_all: np.ndarray,
     is_outer: np.ndarray,
+    prev_K: int | None = None,
 ) -> HoodState:
     D, R, N = epoch.n_devices, epoch.R, len(epoch.leaves)
     owner = epoch.leaves.owner.astype(np.int64)
@@ -381,10 +405,12 @@ def _finish_hood(
 
     send_rows, recv_rows, pair_counts = _hood_schedule(epoch, pairs)
 
-    # --- neighbor gather tables over local rows
+    # --- neighbor gather tables over local rows; Kmax rides the fixed
+    # bucket ladder (pad slots: scratch row, nbr_valid False — exactly
+    # the existing short-row padding)
     counts = np.diff(lists.start)
     Kmax = int(counts.max()) if N else 1
-    Kmax = max(Kmax, 1)
+    Kmax = bucket_k(max(Kmax, 1), prev_K)
     nbr_rows = np.full((D, R, Kmax), scratch, dtype=np.int32)
     nbr_valid = np.zeros((D, R, Kmax), dtype=bool)
     nbr_offset = np.zeros((D, R, Kmax, 3), dtype=np.int32)

@@ -65,8 +65,10 @@ from .epoch import (
     _hood_schedule,
     _row_layout,
 )
+from .shapes import bucket_k
 
-__all__ = ["build_epoch_delta", "delta_enabled", "FALLBACK_REASONS"]
+__all__ = ["build_epoch_delta", "delta_enabled", "FALLBACK_REASONS",
+           "TablePool"]
 
 #: the documented fallback reasons (``epoch.delta_fallbacks{reason=...}``)
 FALLBACK_REASONS = (
@@ -91,6 +93,38 @@ def delta_enabled() -> bool:
     return os.environ.get("DCCRG_EPOCH_DELTA", "1") != "0"
 
 
+class TablePool:
+    """Retained gather-table buffer sets, keyed by ``(D, R, Kmax)``.
+
+    A successful delta rebuild frees the old epoch's five per-hood
+    ``[D, R, Kmax]`` tables; with sticky bucketed shapes the NEXT delta
+    almost always needs buffers of exactly that shape — so the grid
+    parks the freed sets here and ``_patch_tables`` re-initializes them
+    in place (memset-speed ``fill``) instead of re-allocating.  Bounded
+    to a handful of shape keys; holding a set costs the same host memory
+    the retired epoch was already using."""
+
+    MAX_SETS = 4
+
+    def __init__(self):
+        self._sets: list = []  # [(shape, tables), ...] FIFO
+
+    def put(self, tables: tuple) -> None:
+        """Park a freed ``(nbr_rows, nbr_valid, nbr_offset, nbr_len,
+        nbr_slot)`` set."""
+        if len(self._sets) >= self.MAX_SETS:
+            self._sets.pop(0)
+        self._sets.append((tables[0].shape, tables))
+
+    def take(self, D: int, R: int, Kmax: int):
+        want = (D, R, Kmax)
+        for i, (shape, tables) in enumerate(self._sets):
+            if shape == want:
+                del self._sets[i]
+                return tables
+        return None
+
+
 def build_epoch_delta(
     old: Epoch,
     new_leaves: LeafSet,
@@ -98,12 +132,19 @@ def build_epoch_delta(
     neighborhoods: dict,
     *,
     uniform_geometry: bool,
+    shape_hints: dict | None = None,
+    table_pool: TablePool | None = None,
 ) -> Epoch | None:
     """Incrementally derive the epoch for ``new_leaves`` from ``old``.
 
     Returns the patched :class:`Epoch` (bit-identical to a fresh
-    ``build_epoch``), or ``None`` after recording a fallback reason —
-    the caller then pays the full rebuild.
+    ``build_epoch`` given the same ``shape_hints``), or ``None`` after
+    recording a fallback reason — the caller then pays the full rebuild.
+
+    ``shape_hints``/``table_pool``: the grid's shape-hysteresis hints
+    and recycled table buffers (see ``shapes.py`` / :class:`TablePool`);
+    both optional — direct callers get natural buckets and fresh
+    allocations.
     """
     from ..obs import metrics
 
@@ -111,18 +152,26 @@ def build_epoch_delta(
         return None
     try:
         with metrics.phase("epoch.delta_build"):
-            epoch, touched = _build_delta_impl(
+            epoch, touched, kind = _build_delta_impl(
                 old, new_leaves, n_devices, neighborhoods,
                 uniform_geometry=uniform_geometry,
+                shape_hints=shape_hints, table_pool=table_pool,
             )
     except _DeltaFallback as f:
         metrics.inc("epoch.delta_fallbacks", reason=f.reason)
         return None
     if metrics.enabled:
         metrics.inc("epoch.delta_builds")
+        # pure ownership migrations (kind=lb) vs leaf-set changes
+        # (kind=amr) — the two take different thresholds and costs
+        metrics.inc("epoch.delta_builds", kind=kind)
         metrics.inc("epoch.delta_cells_touched", touched)
         metrics.gauge("epoch.n_cells", len(epoch.leaves))
         metrics.gauge("epoch.rows_per_device", epoch.R)
+        metrics.gauge("epoch.bucket_R", epoch.R)
+        for hid, h in epoch.hoods.items():
+            metrics.gauge("epoch.bucket_K", h.nbr_rows.shape[2],
+                          hood="default" if hid is None else str(hid))
         metrics.gauge("epoch.ghost_cells", int(epoch.n_ghost.sum()))
         metrics.gauge("epoch.hoods", len(epoch.hoods))
         metrics.gauge("epoch.send_table_cells", sum(
@@ -137,7 +186,7 @@ def build_epoch_delta(
 
         oracle = build_epoch(
             old.mapping, old.topology, new_leaves, n_devices, neighborhoods,
-            uniform_geometry=uniform_geometry,
+            uniform_geometry=uniform_geometry, shape_hints=shape_hints,
         )
         compare_epochs(epoch, oracle)
     return epoch
@@ -150,7 +199,10 @@ def _build_delta_impl(
     neighborhoods: dict,
     *,
     uniform_geometry: bool,
-) -> tuple[Epoch, int]:
+    shape_hints: dict | None = None,
+    table_pool: TablePool | None = None,
+) -> tuple[Epoch, int, str]:
+    hints = shape_hints or {}
     # --- cheap structural guards
     if n_devices != old.n_devices:
         raise _DeltaFallback("device_count")
@@ -203,7 +255,14 @@ def _build_delta_impl(
         m[new_pos_of_old[surv_lc]] = True
         touched_new |= m
     touched = int(touched_new.sum()) + int(removed_old.sum())
-    max_fraction = _env_float("DCCRG_EPOCH_DELTA_MAX_FRACTION", 0.25)
+    # pure ownership migrations (same_leaves) reuse every neighbor
+    # relation, so their real cost at a given touched fraction is far
+    # below the AMR case — they get their own, higher threshold so the
+    # fast path stays engaged on bigger repartitions
+    if same_leaves:
+        max_fraction = _env_float("DCCRG_EPOCH_DELTA_MAX_FRACTION_LB", 0.75)
+    else:
+        max_fraction = _env_float("DCCRG_EPOCH_DELTA_MAX_FRACTION", 0.25)
     if touched > max_fraction * max(N_new, 1):
         raise _DeltaFallback("fraction")
 
@@ -280,7 +339,8 @@ def _build_delta_impl(
         pairs = np.zeros((0, 2), dtype=np.int64)
 
     # --- row layout (identical code path to the full build)
-    epoch, len_all = _row_layout(mapping, topology, new_leaves, D, pairs)
+    epoch, len_all = _row_layout(mapping, topology, new_leaves, D, pairs,
+                                 prev_R=hints.get("R"))
     max_r_growth = _env_float("DCCRG_EPOCH_DELTA_MAX_R_GROWTH", 1.5)
     if epoch.R > max_r_growth * old.R:
         raise _DeltaFallback("r_growth")
@@ -298,6 +358,7 @@ def _build_delta_impl(
         tables = _patch_tables(
             old, old.hoods[hid], epoch, lists_new, len_all, rec_mask,
             old_pos_of_new, new_pos_of_old,
+            prev_K=hints.get("K", {}).get(hid), table_pool=table_pool,
         )
         epoch.hoods[hid] = HoodState(
             offsets=offsets,
@@ -316,7 +377,7 @@ def _build_delta_impl(
             nbr_slot=tables[4],
         )
     epoch.delta_built = True
-    return epoch, touched
+    return epoch, touched, ("lb" if same_leaves else "amr")
 
 
 def _empty_lists() -> NeighborLists:
@@ -478,6 +539,8 @@ def _patch_tables(
     recompute_mask: np.ndarray,
     old_pos_of_new: np.ndarray,
     new_pos_of_old: np.ndarray,
+    prev_K: int | None = None,
+    table_pool: TablePool | None = None,
 ):
     """The five ``[D, R, Kmax]`` gather tables by patching: surviving
     unmigrated rows outside the closure copy their old row with
@@ -497,15 +560,31 @@ def _patch_tables(
     scratch_old, scratch_new = R_old - 1, R_new - 1
     counts = np.diff(lists.start)
     N_new = len(counts)
-    Kmax = max(int(counts.max()) if N_new else 1, 1)
+    Kmax = bucket_k(max(int(counts.max()) if N_new else 1, 1), prev_K)
     Kold = old_hood.nbr_rows.shape[2]
     Kmin = min(Kmax, Kold)
 
-    nbr_rows = np.full((D, R_new, Kmax), scratch_new, dtype=np.int32)
-    nbr_valid = np.zeros((D, R_new, Kmax), dtype=bool)
-    nbr_offset = np.zeros((D, R_new, Kmax, 3), dtype=np.int32)
-    nbr_len = np.zeros((D, R_new, Kmax), dtype=np.int32)
-    nbr_slot = np.zeros((D, R_new, Kmax), dtype=np.int32)
+    pooled = (table_pool.take(D, R_new, Kmax)
+              if table_pool is not None else None)
+    if pooled is not None:
+        # recycled destination buffers (in-place patch): re-initialize to
+        # the pad values the fresh allocations below would carry — a
+        # memset per table instead of five O(D·R·Kmax) allocations
+        nbr_rows, nbr_valid, nbr_offset, nbr_len, nbr_slot = pooled
+        nbr_rows.fill(scratch_new)
+        nbr_valid.fill(False)
+        nbr_offset.fill(0)
+        nbr_len.fill(0)
+        nbr_slot.fill(0)
+        from ..obs import metrics
+
+        metrics.inc("epoch.table_pool_reuse")
+    else:
+        nbr_rows = np.full((D, R_new, Kmax), scratch_new, dtype=np.int32)
+        nbr_valid = np.zeros((D, R_new, Kmax), dtype=bool)
+        nbr_offset = np.zeros((D, R_new, Kmax, 3), dtype=np.int32)
+        nbr_len = np.zeros((D, R_new, Kmax), dtype=np.int32)
+        nbr_slot = np.zeros((D, R_new, Kmax), dtype=np.int32)
 
     from ..native import native_delta_patch_tables
 

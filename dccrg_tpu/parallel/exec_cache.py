@@ -1,0 +1,179 @@
+"""Persistent executable cache: compiled schedules that survive rebuilds.
+
+Before this cache, every epoch rebuild created fresh ``jax.jit`` objects
+(halo bodies, model step/run kernels), and XLA's compilation cache —
+keyed by Python function identity — could never hit: identical shapes
+recompiled after every AMR commit or repartition.
+
+The cache holds one jitted callable per **structure key** (everything
+that shapes the traced program besides argument shapes: mesh, ring
+distances, dtype, boundary structure...).  Table *contents* flow through
+the callables as runtime arguments, so a rebuild that lands on the same
+:class:`~dccrg_tpu.parallel.shapes.ShapeSignature` re-dispatches the
+existing executable with the new tables — zero retrace, zero recompile.
+jax's own per-function cache keys the argument shapes, which the bucket
+ladders keep sticky.
+
+Bounded LRU (``DCCRG_EPOCH_CACHE_SIZE``, default 64 entries): evicting
+an entry drops the jitted function object and with it every executable
+it compiled.  Telemetry: ``epoch.cache_hits`` / ``epoch.cache_misses``
+/ ``epoch.cache_evictions`` counters and the ``epoch.cache_size`` gauge.
+
+Recompile accounting: kernels built through :func:`traced_jit` run a
+host-side marker at TRACE time (the wrapped Python body executes only
+when jax traces), counting ``epoch.recompiles{kernel=...}`` and a
+process-wide per-label trace count (:func:`trace_counts` — what the
+shape-stability tests assert on).  Dispatches that triggered a trace are
+timed into the ``compile`` phase; warm dispatches cost one counter read.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from ..obs.registry import metrics as _metrics
+
+__all__ = [
+    "ExecutableCache",
+    "traced_jit",
+    "note_trace",
+    "trace_counts",
+    "reset_trace_counts",
+    "mesh_key",
+]
+
+
+def mesh_key(mesh):
+    """A hashable identity for a mesh (jax Mesh hashes by devices+axes;
+    fall back to object identity if a custom mesh type does not)."""
+    try:
+        hash(mesh)
+        return mesh
+    except TypeError:
+        return id(mesh)
+
+_trace_lock = threading.Lock()
+#: label -> number of times a kernel with that label was traced
+_TRACE_COUNTS: dict = {}
+
+
+def note_trace(label: str) -> None:
+    """Record one trace of the kernel ``label`` — called from inside a
+    jitted body, so it fires exactly when jax (re)traces."""
+    with _trace_lock:
+        _TRACE_COUNTS[label] = _TRACE_COUNTS.get(label, 0) + 1
+    _metrics.inc("epoch.recompiles", kernel=label)
+
+
+def trace_counts() -> dict:
+    """Snapshot of per-kernel trace counts since process start (or the
+    last :func:`reset_trace_counts`)."""
+    with _trace_lock:
+        return dict(_TRACE_COUNTS)
+
+
+def reset_trace_counts() -> None:
+    with _trace_lock:
+        _TRACE_COUNTS.clear()
+
+
+def _count(label: str) -> int:
+    with _trace_lock:
+        return _TRACE_COUNTS.get(label, 0)
+
+
+class TracedKernel:
+    """A jitted callable with trace accounting: dispatches that trigger
+    a (re)trace are timed into the ``compile`` phase; warm dispatches
+    add one dict read.  Transparent under another jit's trace — the
+    marker then counts the inlined trace, which is still host compile
+    work."""
+
+    __slots__ = ("fn", "label")
+
+    def __init__(self, fn, label: str):
+        self.fn = fn
+        self.label = label
+
+    def __call__(self, *args):
+        if not _metrics.enabled:
+            return self.fn(*args)
+        n0 = _count(self.label)
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        if _count(self.label) != n0:
+            _metrics.phase_add("compile", time.perf_counter() - t0)
+        return out
+
+
+def traced_jit(label: str, fn, **jit_kwargs) -> TracedKernel:
+    """``jax.jit(fn)`` with trace accounting under ``label`` (see
+    :class:`TracedKernel`)."""
+    import jax
+
+    def marked(*args):
+        note_trace(label)
+        return fn(*args)
+
+    return TracedKernel(jax.jit(marked, **jit_kwargs), label)
+
+
+def _default_size() -> int:
+    try:
+        n = int(os.environ.get("DCCRG_EPOCH_CACHE_SIZE", 64))
+    except ValueError:
+        return 64
+    return max(n, 1)
+
+
+class ExecutableCache:
+    """Bounded LRU of compiled schedule callables, keyed by structure
+    keys (hashable tuples).  Thread-safe; the builder runs outside the
+    lock (builders may themselves consult the cache)."""
+
+    def __init__(self, maxsize: int | None = None):
+        self.maxsize = _default_size() if maxsize is None else max(int(maxsize), 1)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    def get(self, key, builder):
+        """The cached value for ``key``, building (and possibly evicting
+        the least-recently-used entry) on a miss."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                val = self._entries[key]
+                hit = True
+            else:
+                hit = False
+        if hit:
+            _metrics.inc("epoch.cache_hits")
+            return val
+        _metrics.inc("epoch.cache_misses")
+        val = builder()
+        with self._lock:
+            self._entries[key] = val
+            self._entries.move_to_end(key)
+            evicted = 0
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                evicted += 1
+            size = len(self._entries)
+        if evicted:
+            _metrics.inc("epoch.cache_evictions", evicted)
+        _metrics.gauge("epoch.cache_size", size)
+        return val
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

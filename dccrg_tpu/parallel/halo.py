@@ -31,9 +31,16 @@ from jax.sharding import PartitionSpec as P
 from ..utils.compat import shard_map
 
 from ..obs.registry import metrics as _metrics
+from .exec_cache import ExecutableCache, mesh_key as _mesh_key, traced_jit
 from .mesh import SHARD_AXIS, put_table
+from .shapes import bucket_pairs
 
 __all__ = ["HaloExchange", "HaloHandle"]
+
+#: process-wide fallback cache for exchanges constructed without a grid
+#: (tests, ad-hoc schedules) — grid-owned exchanges share the grid's own
+#: bounded cache instead
+_default_cache = ExecutableCache()
 
 
 def _flush_record_cache(cache: dict) -> None:
@@ -113,11 +120,22 @@ class HaloExchange:
     the leading axis.
     """
 
-    def __init__(self, epoch, hood, mesh, cell_datatype=None, hood_id=None):
+    def __init__(self, epoch, hood, mesh, cell_datatype=None, hood_id=None,
+                 exec_cache=None, ring_hints=None):
         self.mesh = mesh
         self.D = epoch.n_devices
         self.R = epoch.R
         self.hood_id = hood_id
+        #: compiled-body cache (grid-owned when built via ``grid.halo``):
+        #: the jitted exchange programs are keyed by ring structure, not
+        #: by this schedule object, so an epoch rebuild that lands on the
+        #:  same shape signature reuses every executable
+        self._cache = exec_cache if exec_cache is not None else _default_cache
+        #: grid-persistent ring-size hysteresis hints
+        #: {(hood_id, field, k): held bucket} — pair counts wiggling
+        #: with churn must not flap the per-distance table shapes, or
+        #: every kernel taking the schedule as an argument retraces
+        self._ring_hints = ring_hints if ring_hints is not None else {}
         #: cells moved per exchange (useful payload, for bandwidth
         #: accounting)
         self.cells_moved = int(hood.pair_counts.sum())
@@ -155,7 +173,7 @@ class HaloExchange:
         self._selective_fns: dict = {}
         (self.ring_ks, self.ring_perms, self.ring_send, self.ring_recv,
          self.wire_cells, _cells,
-         self.ring_sizes) = self._ring_from_pairs(pair_lists)
+         self.ring_sizes) = self._ring_from_pairs(pair_lists, field=None)
         #: per-device cells shipped/received each exchange (telemetry;
         #: pairwise-symmetric by construction, so send and recv totals
         #: agree on every controller).  Static per schedule, so they are
@@ -173,7 +191,7 @@ class HaloExchange:
                                device=d, hood=hood_label)
         self._fn = self._build()
 
-    def _ring_from_pairs(self, pair_lists):
+    def _ring_from_pairs(self, pair_lists, field=None):
         """Ring schedule from exact per-pair row lists: step k ships
         d -> (d+k) % D; only distances some pair actually uses appear,
         each sized by ITS max pair count.  Tables go through the
@@ -193,6 +211,14 @@ class HaloExchange:
             )
             if S_k == 0:
                 continue
+            # ring step sizes ride the geometric bucket ladder (with
+            # grid-persistent hysteresis) so pair counts wiggling with
+            # AMR/LB churn keep the table (and payload) shapes sticky;
+            # pad slots ship the scratch row and scatter back onto it —
+            # bit-identical results, a margin of padded rows on the wire
+            hint_key = (self.hood_id, field, k)
+            S_k = bucket_pairs(S_k, self._ring_hints.get(hint_key))
+            self._ring_hints[hint_key] = S_k
             st = np.full((D, S_k), scratch, np.int32)
             rt = np.full((D, S_k), scratch, np.int32)
             for d in range(D):
@@ -234,7 +260,7 @@ class HaloExchange:
                     filtered[(i, j)] = (np.asarray(sr)[mask],
                                         np.asarray(rr)[mask])
             ks, perms, send, recv, wire, cells, _sizes = (
-                self._ring_from_pairs(filtered)
+                self._ring_from_pairs(filtered, field=name)
             )
             self._field_rings[name] = (ks, perms, send, recv, wire, cells)
         return self._field_rings[name][:4]
@@ -262,41 +288,65 @@ class HaloExchange:
             blk = blk.at[rr].set(p)
         return blk
 
+    @property
+    def structure_key(self) -> tuple:
+        """Everything the compiled bodies' traces depend on besides
+        argument shapes: the mesh and the active ring distances.  Model
+        kernels mix this into their own cache keys."""
+        return (_mesh_key(self.mesh), self.D, tuple(self.ring_ks))
+
+    @property
+    def raw_body(self):
+        """The cached jitted exchange body ``fn(*send_tabs, *recv_tabs,
+        state)``.  Model kernels call this inside their own traces and
+        pass the schedule tables along as arguments, so the composed
+        program embeds no epoch-specific constants."""
+        return self._fn
+
     def _build(self):
         mesh = self.mesh
-        nk = len(self.ring_ks)
-        perms = self.ring_perms
-        data_spec = P(SHARD_AXIS)
-        idx_spec = P(SHARD_AXIS, None)
+        D = self.D
+        ks = tuple(self.ring_ks)
 
-        if nk == 0:
-            # no cross-device pairs (single device, or fully local
-            # neighborhood): the exchange is the identity
-            return jax.jit(lambda *args: args[-1])
+        def build():
+            nk = len(ks)
+            if nk == 0:
+                # no cross-device pairs (single device, or fully local
+                # neighborhood): the exchange is the identity
+                return traced_jit("halo.body", lambda *args: args[-1])
+            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+            data_spec = P(SHARD_AXIS)
+            idx_spec = P(SHARD_AXIS, None)
 
-        def body(*args):
-            sends = [a[0] for a in args[:nk]]          # [S_k] each
-            recvs = [a[0] for a in args[nk:2 * nk]]
-            state = args[2 * nk]
+            def body(*args):
+                sends = [a[0] for a in args[:nk]]          # [S_k] each
+                recvs = [a[0] for a in args[nk:2 * nk]]
+                state = args[2 * nk]
 
-            def exchange_leaf(x):
-                blk = x[0]                             # [R, ...]
-                payloads = HaloExchange.ring_start(blk, perms, sends)
-                return HaloExchange.ring_finish(blk, recvs, payloads)[None]
+                def exchange_leaf(x):
+                    blk = x[0]                             # [R, ...]
+                    payloads = HaloExchange.ring_start(blk, perms, sends)
+                    return HaloExchange.ring_finish(
+                        blk, recvs, payloads
+                    )[None]
 
-            return jax.tree_util.tree_map(exchange_leaf, state)
+                return jax.tree_util.tree_map(exchange_leaf, state)
 
-        fn = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(idx_spec,) * (2 * nk) + (data_spec,),
-            out_specs=data_spec,
-            check_vma=False,
-        )
-        # schedule tables enter as jit ARGUMENTS, not closed-over
-        # constants: closing over an array that spans other controllers'
-        # devices is rejected under multi-process SPMD
-        return jax.jit(fn)
+            fn = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(idx_spec,) * (2 * nk) + (data_spec,),
+                out_specs=data_spec,
+                check_vma=False,
+            )
+            # schedule tables enter as jit ARGUMENTS, not closed-over
+            # constants: closing over an array that spans other
+            # controllers' devices is rejected under multi-process SPMD —
+            # and argument tables are what lets the cached body outlive
+            # the epoch that built this schedule
+            return traced_jit("halo.body", fn)
+
+        return self._cache.get(("halo.body",) + self.structure_key, build)
 
     def _selective(self, names: tuple):
         """Compiled per-field exchange for a cell_datatype policy: each
@@ -306,65 +356,79 @@ class HaloExchange:
         if names in self._selective_fns:
             return self._selective_fns[names]
         rings = [self._rings_for_field(n) for n in names]
-        nks = [len(r[0]) for r in rings]
-        perms_all = [r[1] for r in rings]
+        ks_all = tuple(tuple(r[0]) for r in rings)
         tab_args = []
         for r in rings:
             tab_args.extend(r[2])
             tab_args.extend(r[3])
-        n_tabs = len(tab_args)
-        data_spec = P(SHARD_AXIS)
-        idx_spec = P(SHARD_AXIS, None)
+        mesh = self.mesh
+        D = self.D
 
-        def make_body(mode):
-            def body(*args):
-                pos = 0
-                tabs = []
-                for nk in nks:
-                    sends = [a[0] for a in args[pos:pos + nk]]
-                    recvs = [a[0] for a in args[pos + nk:pos + 2 * nk]]
-                    pos += 2 * nk
-                    tabs.append((sends, recvs))
-                fields = args[pos:pos + len(names)]
-                payloads_in = args[pos + len(names):]
-                out = []
-                for fi, ((sends, recvs), perms, x) in enumerate(
-                    zip(tabs, perms_all, fields)
-                ):
-                    blk = x[0]
-                    if mode == "start":
-                        out.append(tuple(
-                            p[None] for p in
-                            HaloExchange.ring_start(blk, perms, sends)
-                        ))
-                        continue
-                    if mode == "finish":
-                        pay = [q[0] for q in payloads_in[fi]]
-                    else:
-                        pay = HaloExchange.ring_start(blk, perms, sends)
-                    out.append(
-                        HaloExchange.ring_finish(blk, recvs, pay)[None]
-                    )
-                return tuple(out)
+        def build():
+            nks = [len(ks) for ks in ks_all]
+            perms_all = [
+                [[(d, (d + k) % D) for d in range(D)] for k in ks]
+                for ks in ks_all
+            ]
+            n_tabs = 2 * sum(nks)
+            data_spec = P(SHARD_AXIS)
+            idx_spec = P(SHARD_AXIS, None)
 
-            return body
+            def make_body(mode):
+                def body(*args):
+                    pos = 0
+                    tabs = []
+                    for nk in nks:
+                        sends = [a[0] for a in args[pos:pos + nk]]
+                        recvs = [a[0] for a in args[pos + nk:pos + 2 * nk]]
+                        pos += 2 * nk
+                        tabs.append((sends, recvs))
+                    fields = args[pos:pos + len(names)]
+                    payloads_in = args[pos + len(names):]
+                    out = []
+                    for fi, ((sends, recvs), perms, x) in enumerate(
+                        zip(tabs, perms_all, fields)
+                    ):
+                        blk = x[0]
+                        if mode == "start":
+                            out.append(tuple(
+                                p[None] for p in
+                                HaloExchange.ring_start(blk, perms, sends)
+                            ))
+                            continue
+                        if mode == "finish":
+                            pay = [q[0] for q in payloads_in[fi]]
+                        else:
+                            pay = HaloExchange.ring_start(blk, perms, sends)
+                        out.append(
+                            HaloExchange.ring_finish(blk, recvs, pay)[None]
+                        )
+                    return tuple(out)
 
-        def specs(extra):
-            return (idx_spec,) * n_tabs + (data_spec,) * len(names) + extra
+                return body
 
-        block = jax.jit(shard_map(
-            make_body("block"), mesh=self.mesh,
-            in_specs=specs(()), out_specs=data_spec, check_vma=False,
-        ))
-        start = jax.jit(shard_map(
-            make_body("start"), mesh=self.mesh,
-            in_specs=specs(()), out_specs=data_spec, check_vma=False,
-        ))
-        finish = jax.jit(shard_map(
-            make_body("finish"), mesh=self.mesh,
-            in_specs=specs((data_spec,) * len(names)),
-            out_specs=data_spec, check_vma=False,
-        ))
+            def specs(extra):
+                return ((idx_spec,) * n_tabs
+                        + (data_spec,) * len(names) + extra)
+
+            block = traced_jit("halo.selective", shard_map(
+                make_body("block"), mesh=mesh,
+                in_specs=specs(()), out_specs=data_spec, check_vma=False,
+            ))
+            start = traced_jit("halo.selective", shard_map(
+                make_body("start"), mesh=mesh,
+                in_specs=specs(()), out_specs=data_spec, check_vma=False,
+            ))
+            finish = traced_jit("halo.selective", shard_map(
+                make_body("finish"), mesh=mesh,
+                in_specs=specs((data_spec,) * len(names)),
+                out_specs=data_spec, check_vma=False,
+            ))
+            return block, start, finish
+
+        block, start, finish = self._cache.get(
+            ("halo.selective", _mesh_key(mesh), D, names, ks_all), build
+        )
         self._selective_fns[names] = (block, start, finish, tab_args)
         return self._selective_fns[names]
 
@@ -513,57 +577,68 @@ class HaloExchange:
         latency-hiding scheduler overlaps them); ``finish`` scatters the
         payloads into the ghost rows — the data dependence IS the wait."""
         mesh = self.mesh
-        nk = len(self.ring_ks)
-        perms = self.ring_perms
-        data_spec = P(SHARD_AXIS)
-        idx_spec = P(SHARD_AXIS, None)
+        D = self.D
+        ks = tuple(self.ring_ks)
 
-        if nk == 0:
-            self._start_fn = jax.jit(
-                lambda state: jax.tree_util.tree_map(lambda x: (), state)
+        def build():
+            nk = len(ks)
+            if nk == 0:
+                return (
+                    traced_jit(
+                        "halo.start",
+                        lambda state: jax.tree_util.tree_map(
+                            lambda x: (), state
+                        ),
+                    ),
+                    traced_jit("halo.finish", lambda state, payload: state),
+                )
+            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+            data_spec = P(SHARD_AXIS)
+            idx_spec = P(SHARD_AXIS, None)
+
+            def start_body(*args):
+                sends = [a[0] for a in args[:nk]]
+                state = args[nk]
+                return jax.tree_util.tree_map(
+                    lambda x: tuple(
+                        p[None]
+                        for p in HaloExchange.ring_start(x[0], perms, sends)
+                    ),
+                    state,
+                )
+
+            def finish_body(*args):
+                recvs = [a[0] for a in args[:nk]]
+                state, payload = args[nk], args[nk + 1]
+                return jax.tree_util.tree_map(
+                    lambda x, p: HaloExchange.ring_finish(
+                        x[0], recvs, [q[0] for q in p]
+                    )[None],
+                    state,
+                    payload,
+                    is_leaf=lambda v: isinstance(v, tuple),
+                )
+
+            start = shard_map(
+                start_body,
+                mesh=mesh,
+                in_specs=(idx_spec,) * nk + (data_spec,),
+                out_specs=data_spec,
+                check_vma=False,
             )
-            self._finish_fn = jax.jit(lambda state, payload: state)
-            return
-
-        def start_body(*args):
-            sends = [a[0] for a in args[:nk]]
-            state = args[nk]
-            return jax.tree_util.tree_map(
-                lambda x: tuple(
-                    p[None]
-                    for p in HaloExchange.ring_start(x[0], perms, sends)
-                ),
-                state,
+            finish = shard_map(
+                finish_body,
+                mesh=mesh,
+                in_specs=(idx_spec,) * nk + (data_spec, data_spec),
+                out_specs=data_spec,
+                check_vma=False,
             )
+            return (traced_jit("halo.start", start),
+                    traced_jit("halo.finish", finish))
 
-        def finish_body(*args):
-            recvs = [a[0] for a in args[:nk]]
-            state, payload = args[nk], args[nk + 1]
-            return jax.tree_util.tree_map(
-                lambda x, p: HaloExchange.ring_finish(
-                    x[0], recvs, [q[0] for q in p]
-                )[None],
-                state,
-                payload,
-                is_leaf=lambda v: isinstance(v, tuple),
-            )
-
-        start = shard_map(
-            start_body,
-            mesh=mesh,
-            in_specs=(idx_spec,) * nk + (data_spec,),
-            out_specs=data_spec,
-            check_vma=False,
+        self._start_fn, self._finish_fn = self._cache.get(
+            ("halo.split",) + self.structure_key, build
         )
-        finish = shard_map(
-            finish_body,
-            mesh=mesh,
-            in_specs=(idx_spec,) * nk + (data_spec, data_spec),
-            out_specs=data_spec,
-            check_vma=False,
-        )
-        self._start_fn = jax.jit(start)
-        self._finish_fn = jax.jit(finish)
 
     def start(self, state) -> HaloHandle:
         """Dispatch the ghost-payload collectives; returns a

@@ -97,11 +97,17 @@ def verify_grid(grid, check_two_to_one: bool = True) -> None:
 
     if os.environ.get("DCCRG_EPOCH_VERIFY", "0") != "0":
         from ..parallel.epoch import build_epoch
+        from ..parallel.shapes import epoch_shape_hints
 
+        # the oracle rebuild takes the live epoch's shapes as hints:
+        # bucket choice is idempotent against its own result, so a
+        # well-formed epoch is reproduced exactly (hysteresis included)
+        # while any table corruption still trips the comparison
         compare_epochs(epoch, build_epoch(
             grid.mapping, grid.topology, leaves, grid.n_devices,
             grid.neighborhoods,
             uniform_geometry=grid._uniform_geometry(),
+            shape_hints=epoch_shape_hints(epoch),
         ))
 
     # --- directory invariants (is_consistent)

@@ -30,6 +30,9 @@ def sandbox(tmp_path, monkeypatch):
                         lambda: {"stub": True})
     monkeypatch.setattr(bench, "measure_scalability", lambda: {"stub": True})
     monkeypatch.setattr(bench, "measure_cpu_baseline", lambda: 6.5e7)
+    # the shape-stability churn probe spawns a real jax child — stubbed
+    # out like the other slow evidence collectors
+    monkeypatch.setattr(bench, "_attach_epoch_churn", lambda record: None)
     return bench, tmp_path
 
 

@@ -40,9 +40,17 @@ def make_grid(n=8, max_lvl=2, n_dev=8, method="RCB", hood=1,
 
 
 def oracle(g):
+    # the oracle takes the live epoch's shapes as hints: the bucket
+    # choice is idempotent against its own result (parallel/shapes.py),
+    # so the fresh build reproduces the grid-managed epoch exactly —
+    # hysteresis included — while any table corruption still trips the
+    # comparison
+    from dccrg_tpu.parallel.shapes import epoch_shape_hints
+
     return build_epoch(
         g.mapping, g.topology, g.leaves, g.n_devices, g.neighborhoods,
         uniform_geometry=g._uniform_geometry(),
+        shape_hints=epoch_shape_hints(g.epoch),
     )
 
 
@@ -154,6 +162,9 @@ def test_fallback_fraction():
 
 def test_fallback_r_growth(monkeypatch):
     monkeypatch.setenv("DCCRG_EPOCH_DELTA_MAX_R_GROWTH", "1.0")
+    # buckets off: with the geometric ladder + hysteresis a one-cell
+    # refinement is absorbed by the held row budget and R never grows
+    monkeypatch.setenv("DCCRG_EPOCH_BUCKETS", "0")
     g = make_grid(n_dev=8)
     g.refine_completely(1)
     g.stop_refining()

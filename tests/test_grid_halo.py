@@ -119,13 +119,16 @@ def test_send_receive_counts_symmetric():
     assert total_send == total_recv == int(h.pair_counts.sum())
 
 
-def test_ring_schedule_wire_bytes_scale_with_actual_pairs():
+def test_ring_schedule_wire_bytes_scale_with_actual_pairs(monkeypatch):
     """VERDICT-r4 weak 5: the general halo must not be a padded
     worst-pair x D^2 all_to_all.  The ring schedule only runs the
     distances some pair actually communicates over, each sized by its
     own max pair count, so on a slab-partitioned grid the wire traffic
     tracks the real send lists (reference neighbor-only messaging,
-    dccrg.hpp:10564-11070)."""
+    dccrg.hpp:10564-11070).  Buckets off: the exact-schedule property is
+    what's under test (the bucketed margin is asserted separately
+    below)."""
+    monkeypatch.setenv("DCCRG_EPOCH_BUCKETS", "0")
     g = make_grid(length=(8, 8, 8), hood=1)
     h = g.epoch.hoods[None]
     halo = g.halo(None)
@@ -148,6 +151,27 @@ def test_ring_schedule_wire_bytes_scale_with_actual_pairs():
     state = g.new_state({"v": ((), np.float64)})
     assert halo.wire_bytes(state) == halo.wire_cells * 8
     assert halo.bytes_moved(state) == halo.cells_moved * 8
+
+
+def test_ring_schedule_bucketed_margin():
+    """With shape buckets on (the default), each ring step pads up the
+    geometric ladder: wire rows stay within one bucket step of the exact
+    schedule and far below the padded all_to_all equivalent."""
+    from dccrg_tpu.parallel.shapes import bucket_pairs
+
+    g = make_grid(length=(8, 8, 8), hood=1)
+    h = g.epoch.hoods[None]
+    halo = g.halo(None)
+    D = g.n_devices
+    pc = np.asarray(h.pair_counts)
+    dd = np.arange(D)
+    active = {k for k in range(1, D) if pc[dd, (dd + k) % D].max() > 0}
+    assert set(halo.ring_ks) == active
+    want_wire = sum(
+        bucket_pairs(int(pc[dd, (dd + k) % D].max())) * D for k in active
+    )
+    assert halo.wire_cells == want_wire
+    assert halo.wire_cells < D * D * int(pc.max())
 
 
 def test_face_neighbors():

@@ -57,6 +57,9 @@ REQUIRED_PHASES = (
     "checkpoint.write",
     "lineage.commit",
     "lineage.scan",
+    # ISSUE 5: kernel (re)traces are timed — a probe run always compiles
+    # its kernels at least once in a fresh process
+    "compile",
 )
 
 #: counters that must be nonzero after the workload
@@ -76,6 +79,11 @@ REQUIRED_NONZERO_COUNTERS = (
     "checkpoint.crc_failures",
     "lineage.generations_skipped",
     "p2p.retries",
+    # ISSUE 5: compiled-schedule accounting — every fresh process traces
+    # kernels (recompiles), and the churn probe must HIT the executable
+    # cache on its second cycle
+    "epoch.recompiles",
+    "epoch.cache_hits",
 )
 
 
@@ -333,6 +341,55 @@ def _resilience_probe(g, state) -> list:
     return failures
 
 
+def _churn_probe(g, dt) -> list:
+    """Forced churn cycle pair (ISSUE 5): cycle one commits a structural
+    change, rebuilds the model and steps — warming the executable cache
+    for the (possibly new) shape signature; cycle two repeats with an
+    unchanged signature and must compile NOTHING (``epoch.recompiles``
+    stays flat — the zero-retrace contract of shape-stable epochs)."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import obs
+    from dccrg_tpu.models import Advection
+
+    failures: list = []
+
+    def total_recompiles() -> int:
+        rep = obs.metrics.report()
+        return int(sum(rep["counters"].get("epoch.recompiles", {})
+                       .values()))
+
+    def cycle(i: int):
+        cells = g.get_cells()
+        lvl = g.mapping.get_refinement_level(cells)
+        cand = cells[lvl < g.mapping.max_refinement_level]
+        g.refine_completely(int(cand[(i * 13) % len(cand)]))
+        g.stop_refining()
+        adv = Advection(g, dtype=np.float32, allow_dense=False)
+        st = adv.initialize_state()
+        st = adv.step(st, dt)
+        jax.block_until_ready(st["density"])
+
+    cycle(0)
+    sig = g.shape_signature()
+    before = total_recompiles()
+    cycle(1)
+    if g.shape_signature() != sig:
+        failures.append(
+            "churn probe: one-cell commit changed the shape signature "
+            f"({sig} -> {g.shape_signature()}) — bucket hysteresis is "
+            "not holding shapes"
+        )
+    elif total_recompiles() != before:
+        failures.append(
+            f"churn probe: second same-signature cycle recompiled "
+            f"{total_recompiles() - before} kernel(s); the executable "
+            "cache must make it zero"
+        )
+    return failures
+
+
 def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
               reps: int = 5, threshold: float = 1.05) -> list:
     """Run the workload + checks; returns a list of failure strings
@@ -367,6 +424,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
             failures.append("checkpoint round-trip altered the payload")
 
     failures += _resilience_probe(g, state)
+    failures += _churn_probe(g, dt)
 
     report = g.report()
     for phase in REQUIRED_PHASES:

@@ -57,7 +57,87 @@ DEFAULT_PHASES = (
     "amr.refine",
     "checkpoint.write",
     "checkpoint.read",
+    # ISSUE 5: time spent (re)tracing kernels — a round whose compile
+    # mean balloons lost shape stability somewhere
+    "compile",
 )
+
+#: counters gated round-over-round (total across labels): a probe round
+#: that compiles more kernels than the previous round regressed the
+#: shape-stable-epoch contract even if each compile stayed cheap
+GATED_COUNTERS = (
+    "epoch.recompiles",
+)
+
+
+def load_counters(path: str) -> dict | None:
+    """Counter table ``{name: {labels: value}}`` from the same shapes
+    :func:`load_phases` reads, or None when the source carries none."""
+    p = pathlib.Path(path)
+    try:
+        text = p.read_text()
+        if p.suffix == ".jsonl" or "\n{" in text.strip():
+            last = None
+            for ln in text.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "counters" in rec:
+                    last = rec
+            return dict(last["counters"]) if last else None
+        data = json.loads(text)
+        if "counters" in data:
+            return dict(data["counters"])
+        tel = (data.get("detail") or {}).get("telemetry") or {}
+        if "counters" in tel:
+            return dict(tel["counters"])
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    return None
+
+
+def compare_counters(current: dict | None, baseline: dict | None,
+                     threshold: float = 0.35,
+                     counters=GATED_COUNTERS) -> dict:
+    """Round-over-round gate on counter TOTALS (labels summed).  Either
+    side missing the table (old rounds, bench records without counters)
+    passes vacuously — the gate only engages once both rounds carry
+    counter evidence."""
+    rows = []
+    failures = []
+    if current is None or baseline is None:
+        return {"verdict": "PASS", "rows": rows, "failures": failures}
+    for name in counters:
+        b = baseline.get(name)
+        c = current.get(name)
+        if b is None:
+            continue
+        b_tot = sum(b.values())
+        c_tot = sum(c.values()) if c else 0
+        row = {"counter": name, "base_total": b_tot, "cur_total": c_tot}
+        if b_tot > 0:
+            ratio = c_tot / b_tot
+            row["ratio"] = round(ratio, 3)
+            if ratio > 1.0 + threshold:
+                row["status"] = "REGRESSED"
+                failures.append(
+                    f"{name}: total {b_tot} -> {c_tot} ({ratio:.2f}x, "
+                    f"threshold {1 + threshold:.2f}x)"
+                )
+            else:
+                row["status"] = "ok"
+        else:
+            row["status"] = "ok" if c_tot == 0 else "new-activity"
+        rows.append(row)
+    return {
+        "verdict": "FAIL" if failures else "PASS",
+        "rows": rows,
+        "failures": failures,
+    }
 
 #: phases reported but never gated (merged with --allow): the ISSUE 4
 #: resilience phases time fault-injection rounds and recovery scans,
@@ -309,6 +389,17 @@ def main(argv=None) -> int:
                       phases=phases, allow=allow, min_total=args.min_total)
     verdict["current"] = str(args.current)
     verdict["baseline"] = str(baseline_path)
+
+    # counter gate (epoch.recompiles): engages when both rounds carry
+    # counter tables
+    cgate = compare_counters(
+        load_counters(args.current), load_counters(baseline_path),
+        threshold=args.threshold,
+    )
+    verdict["counter_gate"] = cgate
+    if cgate["verdict"] == "FAIL":
+        verdict["verdict"] = "FAIL"
+        verdict["failures"] = list(verdict["failures"]) + cgate["failures"]
 
     # cumulative-drift gate over the retained history window (the
     # round-over-round step gate above cannot see slow creep)
