@@ -1066,6 +1066,7 @@ def _attach_telemetry(record: dict) -> None:
         t = json.loads(tpath.read_text())
         phases = t.get("phases", {})
         counters = t.get("counters", {})
+        gauges = t.get("gauges", {})
         record.setdefault("detail", {})["telemetry"] = {
             "file": "telemetry.json",
             "workload": t.get("workload"),
@@ -1106,6 +1107,25 @@ def _attach_telemetry(record: dict) -> None:
                     k: v for k, v in counters.get(
                         "epoch.delta_builds", {}).items() if k
                 },
+            },
+            # ISSUE 6: the measured device-timeline plane — overlap
+            # fraction (halo in-flight hidden under interior compute),
+            # per-device busy fractions and per-kernel device-time
+            # attribution from the probe's profiled split-phase round.
+            # Empty-valued on deviceless backends (the documented
+            # graceful no-op) so rounds stay comparable either way.
+            "device_timeline": {
+                "overlap_fraction": gauges.get(
+                    "overlap.fraction", {}).get("phase=halo"),
+                "device_busy_fraction": gauges.get(
+                    "device.busy_fraction", {}),
+                "kernel_time_us": counters.get(
+                    "device.kernel_time_us", {}),
+                "merged_trace": (
+                    "telemetry.json.merged_trace.json"
+                    if (ROOT / "telemetry.json.merged_trace.json").exists()
+                    else None
+                ),
             },
         }
     except (OSError, ValueError) as e:

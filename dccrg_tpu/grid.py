@@ -16,6 +16,9 @@ Mirrors the reference's ``Dccrg`` class surface (fluent builder ->
 """
 from __future__ import annotations
 
+import itertools as _itertools
+from contextlib import nullcontext as _nullcontext
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -25,6 +28,7 @@ from .core.topology import Topology
 from .core.neighborhood import default_neighborhood, validate_neighborhood
 from .core.neighbors import InconsistentGridError, LeafSet
 from .geometry import CartesianGeometry, NoGeometry
+from .obs.events import timeline as _timeline
 from .parallel.epoch import build_epoch
 from .parallel.exec_cache import ExecutableCache
 from .parallel.halo import HaloExchange
@@ -43,6 +47,14 @@ CellSpec = dict
 
 #: neighbor-relation criteria bits for ``Grid.get_cells_by_criteria``
 #: (reference ``dccrg.hpp:85-142``)
+#: source of process-unique ``Grid.grid_id`` values (timeline span
+#: separation for concurrent grids — see ``obs.events``)
+_GRID_IDS = _itertools.count()
+
+#: reusable no-op context (``nullcontext`` keeps no state, so one
+#: instance serves every disabled-timeline dispatch)
+_NULL_CTX = _nullcontext()
+
 HAS_NO_NEIGHBOR = 0
 HAS_LOCAL_NEIGHBOR_OF = 1 << 0
 HAS_LOCAL_NEIGHBOR_TO = 1 << 1
@@ -142,6 +154,11 @@ class Grid:
         self._last_removed_cells = np.zeros(0, dtype=np.uint64)
         self._last_adaptation_delta = None
         self._prev_epoch = None
+        #: process-unique id stamped (as ``grid_id``) onto every timeline
+        #: span this grid's instrumented seams record, so traces from
+        #: concurrent grids stay separable in one merged timeline
+        self.grid_id = next(_GRID_IDS)
+        self._tl_ctx = None   # cached reusable timeline context frame
         # compiled-schedule cache + recycled table buffers: both survive
         # every epoch rebuild (the whole point — see parallel/shapes.py)
         from .parallel.epoch_delta import TablePool
@@ -613,9 +630,25 @@ class Grid:
             ring_hints=self._ring_hints,
         )
 
+    def _span_ctx(self):
+        """Timeline context for this grid's instrumented entry points:
+        every span recorded inside (halo dispatches, rebuild phases...)
+        carries ``grid_id`` — workloads layer ``timeline.context(step=i)``
+        on top — so merged traces from concurrent grids stay separable
+        (see ``obs.events.EventTimeline.context``).  The frame object is
+        cached: the per-dispatch cost is an enabled check plus a list
+        push/pop."""
+        if not _timeline.enabled:
+            return _NULL_CTX
+        ctx = self._tl_ctx
+        if ctx is None:
+            ctx = self._tl_ctx = _timeline.context(grid_id=self.grid_id)
+        return ctx
+
     def update_copies_of_remote_neighbors(self, state, hood_id=None):
         """Blocking ghost refresh (reference ``dccrg.hpp:966-1000``)."""
-        return self.halo(hood_id)(state)
+        with self._span_ctx():
+            return self.halo(hood_id)(state)
 
     def start_remote_neighbor_copy_updates(self, state, hood_id=None):
         """Split-phase start (reference ``dccrg.hpp:5010-5105``): launch
@@ -625,7 +658,8 @@ class Grid:
         overlaps them (the reference's overlap pattern,
         ``examples/game_of_life.cpp:124-138``).  Merge with
         ``wait_remote_neighbor_copy_updates(state, handle)``."""
-        return self.halo(hood_id).start(state)
+        with self._span_ctx():
+            return self.halo(hood_id).start(state)
 
     def wait_remote_neighbor_copy_updates(self, state, handle=None, hood_id=None):
         """Split-phase wait: merge the ``start`` handle's payload into the
@@ -633,9 +667,10 @@ class Grid:
         ghost rows now depend on the collective, nothing earlier does.
         Without a handle (legacy form) this degrades to a blocking ghost
         refresh."""
-        if handle is None:
-            return self.halo(hood_id)(state)
-        return self.halo(hood_id).finish(state, handle)
+        with self._span_ctx():
+            if handle is None:
+                return self.halo(hood_id)(state)
+            return self.halo(hood_id).finish(state, handle)
 
     # -------------------------------------------------- user neighborhoods
 
@@ -787,7 +822,7 @@ class Grid:
             raise RuntimeError("a staged balance_load is in progress")
         from .obs import metrics
 
-        with metrics.phase("loadbalance.migrate"):
+        with self._span_ctx(), metrics.phase("loadbalance.migrate"):
             owner = self._compute_new_owner(use_zoltan)
             self._lb_telemetry(self.leaves.owner, owner)
             self._last_new_cells = np.zeros(0, dtype=np.uint64)
@@ -981,7 +1016,7 @@ class Grid:
             raise RuntimeError("a staged balance_load is in progress")
         from .obs import metrics
 
-        with metrics.phase("loadbalance.migrate"):
+        with self._span_ctx(), metrics.phase("loadbalance.migrate"):
             owner = self._compute_new_owner(use_zoltan)
             self._lb_telemetry(self.leaves.owner, owner)
             # load balancing cancels pending adaptation
@@ -1495,7 +1530,7 @@ class Grid:
         # all processes' queued requests (identity under one controller)
         from .obs import metrics
 
-        with metrics.phase("amr.refine"):
+        with self._span_ctx(), metrics.phase("amr.refine"):
             if not presynced:
                 sync_adaptation(self.amr)
             old_epoch = self.epoch
@@ -1638,8 +1673,9 @@ class Grid:
         from .io.checkpoint import CHECKPOINT_VERSION
         from .io.checkpoint import save_grid_data as _save
 
-        _save(self, state, path, spec, user_header, ragged=ragged,
-              version=CHECKPOINT_VERSION if version is None else version)
+        with self._span_ctx():
+            _save(self, state, path, spec, user_header, ragged=ragged,
+                  version=CHECKPOINT_VERSION if version is None else version)
 
     @staticmethod
     def load_grid_data(path: str, spec, mesh=None, n_devices=None, ragged=None,
@@ -1737,6 +1773,7 @@ class Grid:
         rep["events"] = timeline.summary()
         if self.initialized:
             rep["grid"] = {
+                "grid_id": int(self.grid_id),
                 "n_cells": int(len(self.leaves)),
                 "n_devices": int(self.n_devices),
                 "rows_per_device": int(self.epoch.R),

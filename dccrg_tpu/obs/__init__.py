@@ -28,7 +28,16 @@ seam instead:
 * per-device memory gauges (:func:`sample_hbm` ->
   ``hbm.bytes_in_use{device=d}``), sampled at epoch rebuilds and bench
   checkpoints, and post-run reconciliation counters for the fused
-  whole-run kernels that bypass the host halo seam (``obs.fused``).
+  whole-run kernels that bypass the host halo seam (``obs.fused``);
+* the device timeline (``obs.xplane`` + ``obs.merge``): XSpace protos
+  from ``profile_trace`` captures decoded without tensorflow, clock-
+  aligned against the host timeline via sync beacons, and merged into
+  one Chrome trace (host phases as parent track, one pid per device,
+  async ``b``/``e`` collectives) — with measured gauges on top:
+  ``overlap.fraction{phase=halo}``, ``device.busy_fraction{device=d}``
+  and per-kernel ``device.kernel_time_us`` attribution keyed by the
+  same labels ``epoch.recompiles`` counts.  ``DCCRG_XPLANE=0`` opts
+  out; deviceless captures degrade to a documented no-op.
 
 Telemetry is on by default (the recording sites are per-epoch or
 per-host-dispatch, never inside device loops); ``disable()`` — or
@@ -50,6 +59,16 @@ from .events import (
 )
 from .hbm import sample_hbm
 from . import fused
+from . import xplane
+from .merge import (
+    ClockAlignment,
+    MergedTrace,
+    build_merged,
+    build_from_capture,
+    merge_profile,
+    merge_chrome_traces,
+    validate_merged_trace,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -69,4 +88,12 @@ __all__ = [
     "disable_timeline",
     "sample_hbm",
     "fused",
+    "xplane",
+    "ClockAlignment",
+    "MergedTrace",
+    "build_merged",
+    "build_from_capture",
+    "merge_profile",
+    "merge_chrome_traces",
+    "validate_merged_trace",
 ]

@@ -35,6 +35,30 @@ __all__ = [
 ]
 
 
+class _SpanContext:
+    """Reusable context-args frame (see :meth:`EventTimeline.context`).
+    A plain ``__slots__`` object, not a generator contextmanager: the
+    halo seam enters one per dispatch, so entry must cost an append and
+    a conditional dict merge, nothing more."""
+
+    __slots__ = ("_tls", "_args")
+
+    def __init__(self, tls, args):
+        self._tls = tls
+        self._args = args
+
+    def __enter__(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append({**stack[-1], **self._args} if stack else self._args)
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.stack.pop()
+        return False
+
+
 class EventTimeline:
     """Thread-safe bounded span store with a common clock origin.
 
@@ -50,6 +74,7 @@ class EventTimeline:
         self._lock = threading.Lock()
         self._events: list = []   # (name, begin_perf, dur_s, tid, args)
         self._dropped = 0
+        self._ctx = threading.local()
         # clock anchor: perf_counter spans mapped onto wall time
         self._t0_perf = time.perf_counter()
         self._t0_wall = time.time()
@@ -59,18 +84,39 @@ class EventTimeline:
     def add(self, name: str, begin: float, duration: float,
             args: dict | None = None) -> None:
         """Record one completed span (``begin`` in ``perf_counter``
-        time).  No-op when disabled or full (drops are counted)."""
+        time).  No-op when disabled or full (drops are counted, both
+        locally and as the ``timeline.dropped`` registry counter, so a
+        truncated timeline is never misread as a complete one)."""
         if not self.enabled:
             return
         tid = threading.get_ident()
+        ctx = getattr(self._ctx, "stack", None)
+        if ctx:
+            args = {**ctx[-1], **args} if args else ctx[-1]
         with self._lock:
             if len(self._events) >= self.max_events:
                 self._dropped += 1
-                return
-            self._events.append(
-                (str(name), float(begin), max(float(duration), 0.0),
-                 tid, args)
-            )
+                dropping = True
+            else:
+                dropping = False
+                self._events.append(
+                    (str(name), float(begin), max(float(duration), 0.0),
+                     tid, args)
+                )
+        if dropping:
+            metrics.inc("timeline.dropped")
+
+    def context(self, **args):
+        """Default span args for the calling thread: every span recorded
+        while the context is open — registry phases included — carries
+        these args (inner contexts layer on top, explicit span args win).
+        The seam that makes concurrent grids separable in one trace:
+        ``Grid`` opens ``context(grid_id=...)`` around its instrumented
+        entry points, and workloads add ``context(step=i)`` around each
+        step so every span attributes to its iteration.  The returned
+        object is reusable and re-entrant — hot seams (the per-call halo
+        dispatch) cache one instead of rebuilding it per dispatch."""
+        return _SpanContext(self._ctx, args)
 
     @contextmanager
     def span(self, name: str, **args):
@@ -99,7 +145,39 @@ class EventTimeline:
     def summary(self) -> dict:
         with self._lock:
             return {"recorded": len(self._events), "dropped": self._dropped,
-                    "enabled": self.enabled}
+                    "max_events": self.max_events, "enabled": self.enabled}
+
+    def spans(self) -> list:
+        """Snapshot of the recorded spans as plain dicts (``begin`` in
+        the timeline's ``perf_counter`` timebase) — the host half the
+        device-timeline merge (``obs.merge``) consumes."""
+        with self._lock:
+            events = list(self._events)
+        return [
+            {"name": n, "begin": b, "dur": d, "tid": t,
+             "args": dict(a) if a else None}
+            for n, b, d, t, a in events
+        ]
+
+    def rebase(self, origin_perf: float, origin_wall: float = 0.0) -> None:
+        """Move the timeline origin: spans keep their absolute ``begin``
+        stamps, exports re-zero on the new origin.  Used by synthetic
+        timelines built on a foreign clock (``obs.merge`` reconstructs a
+        host track from a capture's own annotations when the live
+        timeline is gone)."""
+        self._t0_perf = float(origin_perf)
+        self._t0_wall = float(origin_wall)
+
+    @property
+    def origin_perf(self) -> float:
+        """``perf_counter`` stamp of the timeline origin (ts == 0)."""
+        return self._t0_perf
+
+    @property
+    def origin_wall(self) -> float:
+        """Wall-clock (unix) time of the timeline origin — the shared
+        epoch-zero the cross-process fleet merge aligns traces on."""
+        return self._t0_wall
 
     def wall_time(self, begin_perf: float) -> float:
         """Wall-clock time of a span's perf-counter begin stamp."""
@@ -147,6 +225,17 @@ class EventTimeline:
                 out.append(ev)
                 stack.append((end, name))
             pop()
+        if dropped:
+            # truncation is part of the trace itself, not just the
+            # summary: an instant marker so a merged/archived trace is
+            # never misread as a complete record
+            out.append({
+                "name": "timeline.truncated", "ph": "i", "s": "p",
+                "pid": pid, "tid": 0,
+                "ts": max((e["ts"] for e in out), default=0.0),
+                "args": {"dropped_events": dropped,
+                         "max_events": self.max_events},
+            })
         return {
             "traceEvents": out,
             "displayTimeUnit": "ms",

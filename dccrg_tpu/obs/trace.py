@@ -21,8 +21,16 @@ def profile_trace(log_dir: str, annotate: bool = True, registry=None):
     """Capture a jax.profiler trace of the enclosed region.
 
     ``annotate`` also switches the registry's phase spans to emit
-    ``TraceAnnotation`` markers while the trace runs (restored after)."""
+    ``TraceAnnotation`` markers while the trace runs (restored after).
+
+    Clock-sync beacons (``obs.xplane.emit_clock_sync``) are dropped at
+    both ends of the capture: the profiler runs on its own timebase, and
+    the beacons are what lets ``obs.merge`` place the captured device
+    spans on the host ``EventTimeline`` clock.  Skipped (with the whole
+    xplane plane) under ``DCCRG_XPLANE=0``."""
     import jax
+
+    from .xplane import emit_clock_sync
 
     reg = registry if registry is not None else metrics
     prev = reg.annotate
@@ -30,10 +38,14 @@ def profile_trace(log_dir: str, annotate: bool = True, registry=None):
         reg.annotate = True
     jax.profiler.start_trace(str(log_dir))
     try:
+        emit_clock_sync()
         yield
     finally:
-        jax.profiler.stop_trace()
-        reg.annotate = prev
+        try:
+            emit_clock_sync()
+        finally:
+            jax.profiler.stop_trace()
+            reg.annotate = prev
 
 
 @contextmanager

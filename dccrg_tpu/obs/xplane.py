@@ -1,0 +1,444 @@
+"""XSpace/xplane ingestion: the device half of the merged timeline.
+
+``jax.profiler`` (wrapped by :func:`obs.profile_trace`) drops its capture
+as ``plugins/profile/<run>/<host>.xplane.pb`` protos under the log dir —
+the XLA profiler's native format (the same schema xprof/TensorBoard
+read: ``tsl/profiler/protobuf/xplane.proto``).  Everything the host
+telemetry plane cannot see lives in there: per-device kernel executions,
+collective dispatches, and the ``TraceAnnotation`` markers host phases
+emit while a trace runs.
+
+This module reads those protos WITHOUT the tensorflow/tsl dependency: an
+``.xplane.pb`` is plain protobuf wire format, and the XSpace schema is
+small and stable, so a ~hundred-line wire decoder covers the subset the
+merge needs (planes -> lines -> events, with the metadata tables that
+intern event/stat names).  Decoding stays pure-python and dependency-free
+— the graceful path when protos are absent (CPU backends that emitted no
+capture, ``DCCRG_XPLANE=0`` opt-outs) is an empty ingest, never an
+ImportError.
+
+What comes out (:func:`ingest`):
+
+* **execution lines** — one per device: the kernel/collective spans that
+  actually ran, each with its XLA program name (``hlo_module``, i.e.
+  ``jit_<kernel>`` for kernels built through
+  :func:`~dccrg_tpu.parallel.exec_cache.traced_jit` — the link back to
+  ``epoch.recompiles{kernel}``).  On accelerator backends these are the
+  ``/device:TPU:N`` planes; on CPU the XLA runtime threads
+  (``tf_XLATfrtCpuClient/...`` inside ``/host:CPU``) play the device
+  role — same spans, same attribution, so the merge/overlap plane is
+  testable on any host;
+* **host markers** — every ``TraceAnnotation`` span on the host plane
+  (phase names under ``profile_trace(annotate=True)``, workload markers,
+  and the clock-sync beacons below);
+* **clock syncs** — the profiler runs on its own timebase (not
+  ``CLOCK_MONOTONIC``; measured skew on this host is ~20,000 s), so
+  :func:`emit_clock_sync` drops zero-work annotations whose NAME embeds
+  ``time.perf_counter_ns()`` at emission.  Re-finding those markers in
+  the capture yields (host perf time, xplane time) pairs —
+  ``obs.merge`` fits the offset that maps device spans onto the
+  ``EventTimeline`` clock (``profile_trace`` emits them automatically).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import struct
+import time
+
+__all__ = [
+    "xplane_enabled",
+    "find_xplane_files",
+    "parse_xplane",
+    "ingest",
+    "emit_clock_sync",
+    "clock_syncs",
+    "CLOCK_SYNC_TAG",
+    "XIngest",
+    "ExecLine",
+    "KernelSpan",
+    "HostMarker",
+]
+
+#: annotation-name prefix of the clock-sync beacons; the part after the
+#: colon is ``time.perf_counter_ns()`` at emission
+CLOCK_SYNC_TAG = "dccrg.clock_sync"
+
+
+def xplane_enabled() -> bool:
+    """``DCCRG_XPLANE=0`` opts the whole device-timeline plane out."""
+    return os.environ.get("DCCRG_XPLANE", "1").lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+# --------------------------------------------------------------------------
+# protobuf wire decoding (the XSpace subset)
+#
+# Field numbers from tsl/profiler/protobuf/xplane.proto:
+#   XSpace:         planes=1
+#   XPlane:         name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+#   XLine:          name=2 timestamp_ns=3 events=4 display_name=11
+#   XEvent:         metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+#   XEventMetadata: id=1 name=2 display_name=4
+#   XStatMetadata:  id=1 name=2
+#   XStat:          metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6
+#                   ref=7
+#   map entries:    key=1 value=2
+# --------------------------------------------------------------------------
+
+
+def _varint(buf, pos: int):
+    """Decode one varint; returns (value, next_pos)."""
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint longer than 10 bytes")
+
+
+def _signed64(v: int) -> int:
+    """Two's-complement view of a varint as int64 (negative int64s are
+    encoded as 10-byte varints)."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf, pos: int, end: int):
+    """Iterate a message's ``(field_number, wire_type, value)`` triples.
+    Length-delimited values come back as memoryview slices; varints as
+    ints; fixed32/64 as raw ints."""
+    while pos < end:
+        tag, pos = _varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:  # groups (3/4): not produced by this schema
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _submsg(view):
+    """(buf, start, end) triple for a length-delimited field value."""
+    return view, 0, len(view)
+
+
+def _map_entry(view):
+    """Decode one ``map<int64, Message>`` entry -> (key, value_view)."""
+    key, val = 0, b""
+    for f, _wt, v in _fields(*_submsg(view)):
+        if f == 1:
+            key = _signed64(v)
+        elif f == 2:
+            val = v
+    return key, val
+
+
+def _decode_stat(view, stat_names: dict):
+    """One XStat -> (name, value); ref values deref through the
+    stat-metadata table (XLA interns repeated strings that way)."""
+    name_id = 0
+    value = None
+    for f, wt, v in _fields(*_submsg(view)):
+        if f == 1:
+            name_id = _signed64(v)
+        elif f == 2:
+            value = struct.unpack("<d", v)[0]
+        elif f == 3:
+            value = v
+        elif f == 4:
+            value = _signed64(v)
+        elif f == 5:
+            value = bytes(v).decode("utf-8", "replace")
+        elif f == 6:
+            value = bytes(v)
+        elif f == 7:
+            value = stat_names.get(v, v)
+    return stat_names.get(name_id, str(name_id)), value
+
+
+class KernelSpan:
+    """One executed kernel/collective on an execution line."""
+
+    __slots__ = ("name", "module", "start_ns", "dur_ns")
+
+    def __init__(self, name, module, start_ns, dur_ns):
+        self.name = name
+        self.module = module
+        self.start_ns = start_ns
+        self.dur_ns = dur_ns
+
+    def __repr__(self):
+        return (f"KernelSpan({self.name!r}, module={self.module!r}, "
+                f"start_ns={self.start_ns}, dur_ns={self.dur_ns})")
+
+
+class HostMarker:
+    """One ``TraceAnnotation`` span found on the host plane."""
+
+    __slots__ = ("name", "start_ns", "dur_ns")
+
+    def __init__(self, name, start_ns, dur_ns):
+        self.name = name
+        self.start_ns = start_ns
+        self.dur_ns = dur_ns
+
+
+class ExecLine:
+    """One device's execution timeline: the kernel spans that ran there.
+    ``kind`` is ``"device"`` for real ``/device:*`` planes, ``"runtime"``
+    for XLA runtime threads standing in on CPU backends."""
+
+    __slots__ = ("device_id", "name", "kind", "spans")
+
+    def __init__(self, device_id, name, kind, spans):
+        self.device_id = device_id
+        self.name = name
+        self.kind = kind
+        self.spans = spans
+
+    def busy_ns(self) -> int:
+        """Union length of this line's span intervals (overlapping spans
+        — nested thunks — are not double-counted)."""
+        ivs = sorted((s.start_ns, s.start_ns + s.dur_ns)
+                     for s in self.spans)
+        total = 0
+        cur_a = cur_b = None
+        for a, b in ivs:
+            if cur_b is None or a > cur_b:
+                if cur_b is not None:
+                    total += cur_b - cur_a
+                cur_a, cur_b = a, b
+            else:
+                cur_b = max(cur_b, b)
+        if cur_b is not None:
+            total += cur_b - cur_a
+        return total
+
+
+class XIngest:
+    """Everything the merge needs from one profiler capture."""
+
+    __slots__ = ("paths", "exec_lines", "markers", "plane_names")
+
+    def __init__(self, paths, exec_lines, markers, plane_names):
+        self.paths = paths
+        self.exec_lines = exec_lines
+        self.markers = markers
+        self.plane_names = plane_names
+
+    @property
+    def has_device_evidence(self) -> bool:
+        """Whether the capture carried any execution line at all — False
+        on backends that emit no device planes AND no XLA runtime
+        threads (the documented graceful no-op case)."""
+        return any(line.spans for line in self.exec_lines)
+
+
+def find_xplane_files(log_dir: str) -> list:
+    """Every ``.xplane.pb`` under a profiler log dir (the
+    ``plugins/profile/<run>/`` layout jax writes), sorted so repeated
+    captures come back in run order."""
+    pats = (
+        os.path.join(str(log_dir), "plugins", "profile", "*", "*.xplane.pb"),
+        os.path.join(str(log_dir), "*.xplane.pb"),
+    )
+    out: list = []
+    for p in pats:
+        out.extend(glob.glob(p))
+    return sorted(out)
+
+
+def parse_xplane(path: str) -> list:
+    """Decode one ``.xplane.pb`` into plain dicts:
+    ``[{name, lines: [{name, timestamp_ns, events: [{name, start_ns,
+    dur_ns, stats}]}]}]`` with every interned name resolved."""
+    with open(path, "rb") as f:
+        buf = memoryview(f.read())
+    planes = []
+    for f_num, _wt, plane_view in _fields(buf, 0, len(buf)):
+        if f_num != 1:
+            continue
+        planes.append(_decode_plane(plane_view))
+    return planes
+
+
+def _decode_plane(view) -> dict:
+    name = ""
+    line_views = []
+    event_names: dict = {}
+    stat_names: dict = {}
+    for f, _wt, v in _fields(*_submsg(view)):
+        if f == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif f == 3:
+            line_views.append(v)
+        elif f == 4:
+            key, mv = _map_entry(v)
+            event_names[key] = _decode_named(mv)
+        elif f == 5:
+            key, mv = _map_entry(v)
+            stat_names[key] = _decode_named(mv)
+    lines = [_decode_line(lv, event_names, stat_names) for lv in line_views]
+    return {"name": name, "lines": lines}
+
+
+def _decode_named(view) -> str:
+    """name (field 2) with display_name (field 4) fallback, from an
+    XEventMetadata / XStatMetadata message."""
+    name = display = ""
+    for f, _wt, v in _fields(*_submsg(view)):
+        if f == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif f == 4:
+            display = bytes(v).decode("utf-8", "replace")
+    return name or display
+
+
+def _decode_line(view, event_names: dict, stat_names: dict) -> dict:
+    name = display = ""
+    timestamp_ns = 0
+    event_views = []
+    for f, _wt, v in _fields(*_submsg(view)):
+        if f == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif f == 3:
+            timestamp_ns = _signed64(v)
+        elif f == 4:
+            event_views.append(v)
+        elif f == 11:
+            display = bytes(v).decode("utf-8", "replace")
+    events = []
+    for ev in event_views:
+        metadata_id = 0
+        offset_ps = dur_ps = 0
+        stat_views = []
+        for f, _wt, v in _fields(*_submsg(ev)):
+            if f == 1:
+                metadata_id = _signed64(v)
+            elif f == 2:
+                offset_ps = _signed64(v)
+            elif f == 3:
+                dur_ps = _signed64(v)
+            elif f == 4:
+                stat_views.append(v)
+        stats = dict(_decode_stat(sv, stat_names) for sv in stat_views)
+        events.append({
+            "name": event_names.get(metadata_id, str(metadata_id)),
+            "start_ns": timestamp_ns + offset_ps / 1000.0,
+            "dur_ns": dur_ps / 1000.0,
+            "stats": stats,
+        })
+    return {"name": name or display, "timestamp_ns": timestamp_ns,
+            "events": events}
+
+
+def _device_ordinal(plane_name: str, fallback: int) -> int:
+    """``/device:TPU:3`` -> 3; anything unparsable gets the fallback."""
+    tail = plane_name.rsplit(":", 1)[-1]
+    try:
+        return int(tail)
+    except ValueError:
+        return fallback
+
+
+def ingest(log_dir: str) -> XIngest:
+    """Parse every capture under ``log_dir`` into execution lines and
+    host markers.  Missing protos, an opted-out plane
+    (``DCCRG_XPLANE=0``), or a capture with no execution evidence all
+    come back as an empty-but-valid :class:`XIngest` — callers branch on
+    :attr:`XIngest.has_device_evidence`, never on exceptions."""
+    paths = find_xplane_files(log_dir) if xplane_enabled() else []
+    exec_lines: list = []
+    markers: list = []
+    plane_names: list = []
+    n_runtime = 0
+    for path in paths:
+        for plane in parse_xplane(path):
+            plane_names.append(plane["name"])
+            is_device = plane["name"].startswith("/device:")
+            for line in plane["lines"]:
+                spans = [
+                    KernelSpan(
+                        ev["name"],
+                        ev["stats"].get("hlo_module"),
+                        ev["start_ns"],
+                        ev["dur_ns"],
+                    )
+                    for ev in line["events"]
+                    if "hlo_module" in ev["stats"] and ev["dur_ns"] > 0
+                ]
+                if is_device:
+                    # a real device plane: every kernel line belongs to
+                    # the plane's ordinal; lines without hlo evidence
+                    # (step markers etc.) contribute nothing
+                    if spans:
+                        exec_lines.append(ExecLine(
+                            _device_ordinal(plane["name"], len(exec_lines)),
+                            f"{plane['name']}/{line['name']}",
+                            "device", spans,
+                        ))
+                    continue
+                if spans:
+                    # XLA runtime thread on a host plane — the CPU
+                    # backend's stand-in for a device line
+                    exec_lines.append(ExecLine(
+                        n_runtime, f"{plane['name']}/{line['name']}",
+                        "runtime", spans,
+                    ))
+                    n_runtime += 1
+                    continue
+                # host thread: keep TraceAnnotation markers (python
+                # tracer frames are interned with a ``$`` prefix —
+                # those are frames, not annotations)
+                markers.extend(
+                    HostMarker(ev["name"], ev["start_ns"], ev["dur_ns"])
+                    for ev in line["events"]
+                    if not ev["name"].startswith("$")
+                )
+    return XIngest(paths, exec_lines, markers, plane_names)
+
+
+def emit_clock_sync(reps: int = 3, tag: str = CLOCK_SYNC_TAG) -> None:
+    """Drop ``reps`` zero-work annotations whose names embed the host
+    ``perf_counter_ns`` at emission — the beacons
+    :func:`clock_syncs` recovers from the capture.  Must run while a
+    profiler trace is active; a no-op cost (~µs each) otherwise."""
+    if not xplane_enabled():
+        return
+    import jax
+
+    for _ in range(reps):
+        t = time.perf_counter_ns()
+        with jax.profiler.TraceAnnotation(f"{tag}:{t}"):
+            pass
+
+
+def clock_syncs(ing: XIngest, tag: str = CLOCK_SYNC_TAG) -> list:
+    """The ``(host_perf_ns, xplane_ns)`` pairs recovered from a
+    capture's sync beacons, emission order."""
+    prefix = tag + ":"
+    out = []
+    for m in ing.markers:
+        if m.name.startswith(prefix):
+            try:
+                out.append((int(m.name[len(prefix):]), m.start_ns))
+            except ValueError:
+                continue
+    return sorted(out)

@@ -29,6 +29,7 @@ timed into the ``compile`` phase; warm dispatches cost one counter read.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -41,6 +42,7 @@ __all__ = [
     "note_trace",
     "trace_counts",
     "reset_trace_counts",
+    "kernel_labels",
     "mesh_key",
 ]
 
@@ -108,15 +110,44 @@ class TracedKernel:
         return out
 
 
+#: XLA module name ("jit_<sanitized label>") -> traced_jit label.  The
+#: attribution link the device-timeline merge closes: kernel events in an
+#: xplane capture carry their ``hlo_module`` name, and this table maps
+#: them back onto the SAME labels ``epoch.recompiles{kernel}`` counts.
+_KERNEL_MODULES: dict = {}
+
+
+def _module_name(label: str) -> str:
+    """The HLO module name a kernel labeled ``label`` compiles under:
+    jax names modules ``jit_<fn.__name__>``, and :func:`traced_jit`
+    renames its wrapper to the (identifier-sanitized) label."""
+    return "jit_" + re.sub(r"[^0-9A-Za-z_]", "_", label)
+
+
+def kernel_labels() -> dict:
+    """Snapshot of the ``hlo_module name -> kernel label`` table for
+    every kernel built through :func:`traced_jit` in this process."""
+    with _trace_lock:
+        return dict(_KERNEL_MODULES)
+
+
 def traced_jit(label: str, fn, **jit_kwargs) -> TracedKernel:
     """``jax.jit(fn)`` with trace accounting under ``label`` (see
-    :class:`TracedKernel`)."""
+    :class:`TracedKernel`).  The wrapper is renamed to the sanitized
+    label so the compiled program's ``hlo_module`` name — which every
+    device-timeline kernel span carries — is ``jit_<label>``: device
+    time attributes back to exactly the kernel names the recompile
+    counters use (:func:`kernel_labels` holds the mapping)."""
     import jax
 
     def marked(*args):
         note_trace(label)
         return fn(*args)
 
+    module = _module_name(label)
+    marked.__name__ = marked.__qualname__ = module[len("jit_"):]
+    with _trace_lock:
+        _KERNEL_MODULES[module] = label
     return TracedKernel(jax.jit(marked, **jit_kwargs), label)
 
 

@@ -273,19 +273,31 @@ class HaloExchange:
         for this device's ``[R, ...]`` block; returns the in-flight
         ``[S_k, ...]`` payloads (one per ring distance).  The single
         definition of the wire protocol — the blocking exchange, the
-        split-phase pair, and workload overlap kernels all call this."""
-        return [
-            jax.lax.ppermute(blk[sr], SHARD_AXIS, perm)
-            for perm, sr in zip(perms, send_tabs)
-        ]
+        split-phase pair, and workload overlap kernels all call this.
+
+        Each step is wrapped in a ``named_scope`` keyed by its ring
+        distance k (``perm[0]`` is ``(0, k)`` by construction), so the
+        collective's HLO ops — and with them the device-timeline spans
+        the xplane merge extracts — carry a name that is STABLE across
+        epoch rebuilds: ``halo.ring.k3.start`` attributes to ring
+        distance 3 in every trace, regardless of how the schedule was
+        rebuilt."""
+        out = []
+        for perm, sr in zip(perms, send_tabs):
+            with jax.named_scope(f"halo.ring.k{perm[0][1]}.start"):
+                out.append(jax.lax.ppermute(blk[sr], SHARD_AXIS, perm))
+        return out
 
     @staticmethod
     def ring_finish(blk, recv_tabs, payloads):
         """Inside a shard_map body: scatter ``ring_start`` payloads into
         this device's ghost rows (padded slots land on the scratch
-        row)."""
-        for rr, p in zip(recv_tabs, payloads):
-            blk = blk.at[rr].set(p)
+        row).  Scatter ops are scoped by ring-schedule position (the
+        receive direction of step i), mirroring ``ring_start``'s
+        per-distance scopes."""
+        for i, (rr, p) in enumerate(zip(recv_tabs, payloads)):
+            with jax.named_scope(f"halo.ring.r{i}.finish"):
+                blk = blk.at[rr].set(p)
         return blk
 
     @property
@@ -647,7 +659,18 @@ class HaloExchange:
         if isinstance(state, HaloHandle):
             raise TypeError("start() takes the state, not a HaloHandle")
         if _metrics.enabled and not _tracing(state):
+            # timed as its own phase (not halo.exchange): the span from
+            # a halo.start begin to the next halo.exchange (finish) end
+            # is the collective's in-flight window — the denominator of
+            # the measured overlap fraction (obs/merge.py)
             self._record(state, "split")
+            t0 = time.perf_counter()
+            out = self._start_dispatch(state)
+            _metrics.phase_add("halo.start", time.perf_counter() - t0)
+            return out
+        return self._start_dispatch(state)
+
+    def _start_dispatch(self, state) -> HaloHandle:
         if self._cell_datatype is not None:
             names = self._names(state)
             _block, start, _finish, tab_args = self._selective(names)

@@ -226,3 +226,50 @@ def test_history_file_rolls_and_feeds_drift(diff, tmp_path):
     rc = diff.main(["--current", str(steep), "--baseline", str(steep),
                     "--history", str(hist)])
     assert rc == 1  # cumulative drift vs oldest retained round
+
+
+def test_gauge_floor_gate(diff, tmp_path):
+    """ISSUE 6: overlap.fraction is gated as a FLOOR — a drop below
+    (1 - threshold) x baseline fails; rises, vacuous sides and
+    zero-baseline values never do; a labeled series vanishing is a
+    coverage loss."""
+    base = {"overlap.fraction": {"phase=halo": 0.6}}
+    assert diff.compare_gauges(
+        {"overlap.fraction": {"phase=halo": 0.55}}, base
+    )["verdict"] == "PASS"
+    assert diff.compare_gauges(
+        {"overlap.fraction": {"phase=halo": 0.9}}, base
+    )["verdict"] == "PASS"
+    bad = diff.compare_gauges(
+        {"overlap.fraction": {"phase=halo": 0.2}}, base, threshold=0.35
+    )
+    assert bad["verdict"] == "FAIL"
+    assert "0.2" in bad["failures"][0]
+    missing = diff.compare_gauges({"overlap.fraction": {}}, base)
+    assert missing["verdict"] == "FAIL"
+    assert "coverage loss" in missing["failures"][0]
+    assert diff.compare_gauges(None, base)["verdict"] == "PASS"
+    assert diff.compare_gauges({}, None)["verdict"] == "PASS"
+    assert diff.compare_gauges(
+        {}, {"overlap.fraction": {"phase=halo": 0}}
+    )["verdict"] == "FAIL"  # label present with value 0 still must exist
+
+
+def test_load_gauges_shapes(diff, tmp_path):
+    tel = tmp_path / "telemetry.json"
+    tel.write_text(json.dumps({
+        "phases": {}, "counters": {},
+        "gauges": {"overlap.fraction": {"phase=halo": 0.5}},
+    }))
+    assert diff.load_gauges(str(tel)) == {
+        "overlap.fraction": {"phase=halo": 0.5}
+    }
+    stream = tmp_path / "s.jsonl"
+    stream.write_text(
+        json.dumps({"gauges": {"g": {"": 1}}}) + "\n"
+        + json.dumps({"gauges": {"g": {"": 2}}}) + "\n"
+    )
+    assert diff.load_gauges(str(stream)) == {"g": {"": 2}}  # last line wins
+    nothing = tmp_path / "n.json"
+    nothing.write_text(json.dumps({"phases": {}}))
+    assert diff.load_gauges(str(nothing)) is None

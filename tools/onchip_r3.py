@@ -130,16 +130,21 @@ sys.path.insert(0, %r)
 import jax
 import numpy as np
 try:
-    import atexit
+    import atexit, os
     from dccrg_tpu import obs as _obs
     _obs.stream_to(%r, period=30.0, truncate=True,
                    extra={"source": "onchip_battery"})
     atexit.register(lambda: print(
         "TELEMETRY:" + json.dumps(_obs.metrics.report()["phases"]),
         flush=True))
+    # per-child timeline export (origin_unix_s anchors the post-battery
+    # fleet merge: tools/trace_report.py --fleet tools/onchip_trace_*.json)
+    atexit.register(lambda: _obs.export_chrome_trace(
+        %r + "onchip_trace_%%d.json" %% os.getpid()))
 except Exception as _e:
     print("battery telemetry unavailable:", _e, file=sys.stderr)
-""" % (str(ROOT), str(ROOT / "tools" / "onchip_stream.jsonl"))
+""" % (str(ROOT), str(ROOT / "tools" / "onchip_stream.jsonl"),
+       str(ROOT / "tools") + "/")
 
 #: key -> (child code, timeout).  bench.measure_* are the single source
 #: of truth for configurations; each runs alone in a child.

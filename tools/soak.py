@@ -773,6 +773,13 @@ from dccrg_tpu.resilience.manager import CheckpointLineage
 
 obs.stream_to(os.path.join(wd, 'child_stream.jsonl'), period=2.0,
               extra={'subsystem': 'crash', 'seed': seed, 'n_devices': nd})
+# per-child timeline export at exit: carries origin_unix_s, the anchor
+# the post-run fleet merge (obs.merge_chrome_traces) unifies children on.
+# A SIGKILLed attempt leaves no trace file — the surviving attempts'
+# traces still merge (crash evidence lives in the streams, not here).
+import atexit as _atexit
+_atexit.register(lambda: obs.export_chrome_trace(
+    os.path.join(wd, 'child_%d.trace.json' % os.getpid())))
 
 
 def atomic_save(path, arr):
@@ -1014,6 +1021,17 @@ def run_crash(lo: int, hi: int, stream_dir: str | None = None,
             record(seed=seed, outcome="ok", attempts=attempt + 1)
             print(f"crash seed {seed}: OK after {attempt + 1} attempt(s)")
         finally:
+            # salvage child timeline exports before the workdir goes:
+            # they carry origin_unix_s, the anchor the post-run fleet
+            # merge unifies every process on (SIGKILLed attempts left
+            # none — the streams keep their evidence)
+            if stream_dir:
+                import glob as _glob
+
+                for i, t in enumerate(sorted(_glob.glob(
+                        os.path.join(tmp, "*", "child_*.trace.json")))):
+                    shutil.copy(t, os.path.join(
+                        stream_dir, f"crash_{seed}_{i}.trace.json"))
             shutil.rmtree(tmp, ignore_errors=True)
     if stream is not None:
         stream.stop(final=True)
@@ -1034,6 +1052,10 @@ try:
     from dccrg_tpu import obs as _obs
     _obs.stream_to(%r, period=%r, truncate=True,
                    extra={"subsystem": %r, "seeds": %r})
+    # timeline export at exit: the per-process half of the fleet trace
+    # (origin_unix_s anchors the post-run merge on a shared epoch-zero)
+    import atexit as _atexit
+    _atexit.register(lambda: _obs.export_chrome_trace(%r))
 except Exception as _e:  # telemetry must never break the fuzz
     print("soak stream unavailable:", _e)
 """
@@ -1062,8 +1084,9 @@ def run(name: str, lo: int, hi: int, stream_dir: str | None = None) -> bool:
 
         os.makedirs(stream_dir, exist_ok=True)
         spath = os.path.join(stream_dir, f"{name}_{lo}_{hi}.jsonl")
+        tpath = os.path.join(stream_dir, f"{name}_{lo}_{hi}.trace.json")
         code = STREAM_PRELUDE % (
-            str(ROOT), spath, 5.0, name, [lo, hi],
+            str(ROOT), spath, 5.0, name, [lo, hi], tpath,
         ) + code
     r = subprocess.run(
         [sys.executable, "-c", code, str(lo), str(hi)],
@@ -1082,6 +1105,32 @@ def run(name: str, lo: int, hi: int, stream_dir: str | None = None) -> bool:
         print(r.stdout[-2000:])
         print(r.stderr[-2000:])
     return ok
+
+
+def merge_fleet(stream_dir: str) -> str | None:
+    """Post-run step: unify every per-process timeline export under
+    ``stream_dir`` (battery runs + salvaged crash children) into ONE
+    fleet trace on their shared epoch-zero (``obs.merge_chrome_traces``
+    aligns on each trace's ``origin_unix_s``).  Returns the fleet trace
+    path, or None when no child exported a timeline."""
+    import glob as _glob
+    import os
+
+    traces = sorted(_glob.glob(os.path.join(stream_dir, "*.trace.json")))
+    if not traces:
+        return None
+    sys.path.insert(0, str(ROOT))
+    try:
+        from dccrg_tpu.obs.merge import merge_chrome_traces
+
+        out = os.path.join(stream_dir, "fleet_trace.json")
+        fleet = merge_chrome_traces(traces, out_path=out)
+        print(f"fleet trace: {len(fleet['traceEvents'])} events from "
+              f"{len(traces)} process timelines -> {out}")
+        return out
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the soak
+        print(f"fleet merge unavailable: {e}")
+        return None
 
 
 def main():
@@ -1111,6 +1160,8 @@ def main():
             lo, hi = a.crash_seeds or (a.seeds[0],
                                        min(a.seeds[0] + 3, a.seeds[1]))
             results.append(run_crash(lo, hi, stream_dir=sdir))
+    if sdir:
+        merge_fleet(sdir)
     sys.exit(0 if all(results) else 1)
 
 

@@ -50,6 +50,10 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 #: rebuild); --phases overrides
 DEFAULT_PHASES = (
     "halo.exchange",
+    # ISSUE 6: the split-phase dispatch seam — the in-flight window the
+    # overlap gauge measures is opened here, and its dispatch cost is a
+    # hot-path regression like the blocking exchange's
+    "halo.start",
     "epoch.build",
     "epoch.hood_build",
     "epoch.delta_build",
@@ -143,11 +147,98 @@ def compare_counters(current: dict | None, baseline: dict | None,
 #: resilience phases time fault-injection rounds and recovery scans,
 #: whose cost is dominated by how many faults the round armed and how
 #: many generations the scan had to skip — round-over-round variation
-#: there is workload-shaped, not a perf regression
+#: there is workload-shaped, not a perf regression.  Same for the
+#: ISSUE 6 trace-processing phases: ingest/merge cost scales with how
+#: many spans the profiled round happened to capture.
 DEFAULT_ALLOW = (
     "lineage.commit",
     "lineage.scan",
+    "xplane.ingest",
+    "trace.merge",
 )
+
+#: gauges gated round-over-round where a DROP is the regression: the
+#: measured halo overlap fraction falling means communication stopped
+#: hiding under compute — exactly what the device-timeline plane exists
+#: to catch.  Engages only when both rounds carry the gauge (older
+#: rounds and deviceless backends pass vacuously).
+GATED_GAUGES_MIN = (
+    "overlap.fraction",
+)
+
+
+def load_gauges(path: str) -> dict | None:
+    """Gauge table ``{name: {labels: value}}`` from the same shapes
+    :func:`load_phases` reads, or None when the source carries none."""
+    p = pathlib.Path(path)
+    try:
+        text = p.read_text()
+        if p.suffix == ".jsonl" or "\n{" in text.strip():
+            last = None
+            for ln in text.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "gauges" in rec:
+                    last = rec
+            return dict(last["gauges"]) if last else None
+        data = json.loads(text)
+        if "gauges" in data:
+            return dict(data["gauges"])
+        tel = (data.get("detail") or {}).get("telemetry") or {}
+        if "gauges" in tel:
+            return dict(tel["gauges"])
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    return None
+
+
+def compare_gauges(current: dict | None, baseline: dict | None,
+                   threshold: float = 0.35,
+                   gauges=GATED_GAUGES_MIN) -> dict:
+    """Floor gate on per-label gauge values: fails when a gated gauge
+    DROPS below ``baseline * (1 - threshold)`` (regression direction is
+    down — these are goodness fractions).  A labeled series present in
+    the baseline but missing from the current round is a coverage loss
+    and fails; either side lacking the whole table passes vacuously."""
+    rows = []
+    failures = []
+    if current is None or baseline is None:
+        return {"verdict": "PASS", "rows": rows, "failures": failures}
+    for name in gauges:
+        base_series = baseline.get(name)
+        if not base_series:
+            continue
+        cur_series = current.get(name) or {}
+        for label, b in base_series.items():
+            c = cur_series.get(label)
+            row = {"gauge": name, "labels": label, "base": b, "cur": c}
+            if c is None:
+                row["status"] = "MISSING"
+                failures.append(
+                    f"{name}{{{label}}}: present in baseline ({b}), "
+                    "missing from current round (coverage loss)"
+                )
+            elif not isinstance(b, (int, float)) or b <= 0:
+                row["status"] = "ok"  # nothing to regress from
+            elif c < b * (1.0 - threshold):
+                row["status"] = "REGRESSED"
+                failures.append(
+                    f"{name}{{{label}}}: {b} -> {c} "
+                    f"(below {1 - threshold:.2f}x floor)"
+                )
+            else:
+                row["status"] = "ok"
+            rows.append(row)
+    return {
+        "verdict": "FAIL" if failures else "PASS",
+        "rows": rows,
+        "failures": failures,
+    }
 
 
 def load_phases(path: str) -> dict:
@@ -400,6 +491,17 @@ def main(argv=None) -> int:
     if cgate["verdict"] == "FAIL":
         verdict["verdict"] = "FAIL"
         verdict["failures"] = list(verdict["failures"]) + cgate["failures"]
+
+    # gauge floor gate (overlap.fraction): engages when both rounds
+    # carry the gauge — a drop means compute stopped hiding the halo
+    ggate = compare_gauges(
+        load_gauges(args.current), load_gauges(baseline_path),
+        threshold=args.threshold,
+    )
+    verdict["gauge_gate"] = ggate
+    if ggate["verdict"] == "FAIL":
+        verdict["verdict"] = "FAIL"
+        verdict["failures"] = list(verdict["failures"]) + ggate["failures"]
 
     # cumulative-drift gate over the retained history window (the
     # round-over-round step gate above cannot see slow creep)
