@@ -654,6 +654,93 @@ def measure_vlasov() -> dict:
     }
 
 
+def measure_halo_backends() -> dict:
+    """ISSUE 7 on-chip target: blocking-exchange latency per halo
+    transport (collective ppermute vs Pallas async-DMA ring) on the
+    refined general-path grid, oracle-verified.  Backend is pinned per
+    HaloExchange construction, so each variant builds its own grid."""
+    import jax
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+
+    def build():
+        n = 16
+        g = (Grid().set_initial_length((n, n, n))
+             .set_neighborhood_length(1)
+             .set_periodic(True, True, True)
+             .set_maximum_refinement_level(1)
+             .set_load_balancing_method("RCB")
+             .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                           level_0_cell_length=(1.0 / n,) * 3)
+             .initialize(mesh=make_mesh()))
+        ids = g.get_cells()
+        ctr = g.geometry.get_center(ids)
+        g.refine_completely_many(
+            ids[np.linalg.norm(ctr - 0.5, axis=1) < 0.25]
+        )
+        g.stop_refining()
+        g.balance_load()
+        return g
+
+    out = {"device_kind": jax.devices()[0].device_kind,
+           "platform": jax.devices()[0].platform,
+           "n_devices": len(jax.devices())}
+    prev = os.environ.get("DCCRG_HALO_BACKEND")
+    try:
+        for backend in ("collective", "pallas"):
+            os.environ["DCCRG_HALO_BACKEND"] = backend
+            g = build()
+            ex = g.halo()
+            state = g.new_state({"rho": ((), np.float32)})
+            cells = g.get_cells()
+            state = g.set_cell_data(
+                state, "rho", cells,
+                np.sin(cells.astype(np.float64)).astype(np.float32),
+            )
+            ref = g.update_copies_of_remote_neighbors(state)
+            jax.block_until_ready(ref["rho"])
+            secs, times, outst = _median_of(
+                lambda: g.update_copies_of_remote_neighbors(state)["rho"],
+                n=30,
+            )
+            out[backend] = {
+                "selected": ex.backend,
+                "ring_ks": list(ex.ring_ks),
+                "exchange_s": round(secs, 6),
+                "bytes_moved": ex.bytes_moved({"rho": state["rho"]}),
+                "wire_bytes": ex.wire_bytes({"rho": state["rho"]}),
+            }
+            out[backend]["wire_GBps"] = round(
+                out[backend]["wire_bytes"] / secs / 1e9, 3
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("DCCRG_HALO_BACKEND", None)
+        else:
+            os.environ["DCCRG_HALO_BACKEND"] = prev
+    if "collective" in out and "pallas" in out:
+        out["pallas_speedup"] = round(
+            out["collective"]["exchange_s"]
+            / max(out["pallas"]["exchange_s"], 1e-12), 3,
+        )
+    return out
+
+
+def measure_split_fused() -> dict:
+    """ISSUE 7 on-chip target: the fused split-phase steps (advection,
+    vlasov, gol) vs their eager forms on the refined general-path grid —
+    the halo_overlap microbench run wherever the tunnel lands it."""
+    import jax
+
+    from benchmarks.microbench import halo_overlap_summary
+
+    out = halo_overlap_summary(steps=20, reps=3, profile=False)
+    out["device_kind"] = jax.devices()[0].device_kind
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def measure_multidev_cpu() -> dict | None:
     """8-device virtual CPU mesh (subprocess): plumbing/correctness
     evidence (device-count-invariant checksum) plus the split-phase
@@ -1055,6 +1142,41 @@ def _attach_epoch_churn(record: dict) -> None:
         print(f"epoch churn probe failed: {e}", file=sys.stderr)
 
 
+def _attach_halo_overlap(record: dict) -> None:
+    """Fold the halo-overlap sweep (ISSUE 7) into the record under
+    ``detail.telemetry.halo_overlap``: eager vs host-split vs fused
+    split-phase step latency per model plus the measured per-model
+    ``overlap.fraction`` — run on the 8-device virtual CPU mesh in a
+    child so an accelerator outage never blocks the bench line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from benchmarks.microbench import halo_overlap_summary; "
+        "print(json.dumps(halo_overlap_summary(steps=15, reps=2)))"
+        % str(ROOT)
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            print(f"halo overlap probe failed: {r.stderr[-300:]}",
+                  file=sys.stderr)
+            return
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        record.setdefault("detail", {}).setdefault(
+            "telemetry", {})["halo_overlap"] = json.loads(line)
+    except Exception as e:  # noqa: BLE001 - telemetry never kills the bench
+        print(f"halo overlap probe failed: {e}", file=sys.stderr)
+
+
 def _attach_telemetry(record: dict) -> None:
     """Fold telemetry.json's phase breakdown into the bench record so
     BENCH_*.json rounds carry where epoch/halo/LB/AMR/checkpoint time
@@ -1154,6 +1276,7 @@ def _emit(record: dict):
     weak #1) — in the outage fallback too."""
     _attach_telemetry(record)
     _attach_epoch_churn(record)
+    _attach_halo_overlap(record)
     try:
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
     except OSError as e:

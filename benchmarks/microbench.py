@@ -21,11 +21,16 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
-# host-side measurements must not depend on (or hang with) an accelerator
-# tunnel; force the CPU backend like tests/conftest.py
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if __name__ == "__main__":
+    # host-side measurements must not depend on (or hang with) an
+    # accelerator tunnel; force the CPU backend like tests/conftest.py —
+    # but only when run AS the script: bench.py's on-chip battery
+    # children import pieces of this module (pic_setup,
+    # halo_overlap_summary) and must keep the backend the tunnel gave
+    # them, not get silently flipped to CPU by an import side effect
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -472,6 +477,173 @@ def bench_churn_compile(length: int = 12, cycles: int = 6):
     }))
 
 
+def halo_overlap_summary(steps: int = 20, length: int = 8, reps: int = 3,
+                         seed: int = 0, profile: bool = True) -> dict:
+    """Eager vs host-split vs fused split-phase stepping per model
+    (gol / advection / vlasov) on the current device mesh (ISSUE 7),
+    importable so ``bench.py`` can fold it into BENCH_DETAIL.json
+    (``detail.telemetry.halo_overlap``).
+
+    Three forms of advancing one step:
+
+    * ``eager`` — the blocking step (ghost exchange fused into the
+      model's program);
+    * ``host_split`` — the source paper's host-orchestrated pattern
+      (``start_remote_neighbor_copies`` / eager step / ``wait``): one
+      EXTRA host-level refresh rides along per step, so this column is
+      an upper bound showing the dispatch overhead the fused form
+      removes;
+    * ``fused`` — the model's ``overlap=True`` step: start → interior →
+      finish → boundary inside ONE compiled program.
+
+    ``overlap_fraction`` per model is MEASURED (not inferred): a
+    profiled fused round merged against the device timeline
+    (``obs.merge_profile``), None when the backend leaves no execution
+    lines."""
+    import jax
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import Advection, GameOfLife, Vlasov
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(1)
+        .set_load_balancing_method("RCB")
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / length,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    ids = g.get_cells()
+    ctr = g.geometry.get_center(ids)
+    g.refine_completely_many(ids[np.linalg.norm(ctr - 0.5, axis=1) < 0.3])
+    g.stop_refining()
+    g.balance_load()
+    rng = np.random.default_rng(seed)
+    cells = g.get_cells()
+
+    def median_step(step, state):
+        s = step(state)
+        jax.block_until_ready(s)                      # warm the compiles
+        times = []
+        for _ in range(reps):
+            s = state
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                s = step(s)
+            jax.block_until_ready(s)
+            times.append((time.perf_counter() - t0) / steps)
+        return float(np.median(times))
+
+    def measured_overlap(step, state, model):
+        """Profiled fused round -> overlap.fraction{model=...}."""
+        import tempfile
+
+        obs.enable()
+        obs.enable_timeline()
+
+        def stamped(s):
+            t0 = time.perf_counter()
+            out = step(s)
+            obs.metrics.phase_add("halo.start", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(out)
+            obs.metrics.phase_add("halo.exchange",
+                                  time.perf_counter() - t0)
+            return out
+
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                with obs.profile_trace(td):
+                    s = state
+                    for _ in range(4):
+                        s = stamped(s)
+                _merged, summary = obs.merge_profile(
+                    td, extra_labels={"model": model}
+                )
+            if not summary["device_evidence"]:
+                return None
+            return summary["overlap"]["halo"]["fraction"]
+        except Exception:  # noqa: BLE001 — measurement, never the bench
+            return None
+
+    out: dict = {"n_devices": g.n_devices, "steps": steps,
+                 "n_cells": int(len(cells)),
+                 "halo_backend": g.halo().backend, "models": {}}
+
+    for model in ("gol", "advection", "vlasov"):
+        if model == "gol":
+            eager = GameOfLife(g, allow_dense=False)
+            fused = GameOfLife(g, overlap=True)
+            alive0 = cells[rng.random(len(cells)) < 0.3]
+            state_e = eager.new_state(alive_cells=alive0)
+            state_f = fused.new_state(alive_cells=alive0)
+            field = "is_alive"
+            step_e = eager.step
+            step_f = fused.step
+        elif model == "advection":
+            eager = Advection(g, dtype=np.float32, allow_dense=False)
+            fused = Advection(g, dtype=np.float32, allow_dense=False,
+                              overlap=True)
+            state_e = eager.initialize_state()
+            state_f = fused.initialize_state()
+            dt = np.float32(0.4 * eager.max_time_step(state_e))
+            field = "density"
+            step_e = lambda s: eager.step(s, dt)
+            step_f = lambda s: fused.step(s, dt)
+        else:
+            eager = Vlasov(g, nv=2, dtype=np.float32)
+            fused = Vlasov(g, nv=2, dtype=np.float32, overlap=True)
+            state_e = eager.initialize_state()
+            state_f = fused.initialize_state()
+            dt = np.float32(0.5 * eager.max_time_step())
+            field = "f"
+            step_e = lambda s, _e=eager, _dt=dt: _e.step(s, _dt)
+            step_f = lambda s, _f=fused, _dt=dt: _f.step(s, _dt)
+
+        def step_split(s, _step=step_e, _field=field):
+            fields = {_field: s[_field]}
+            handle = g.start_remote_neighbor_copy_updates(fields)
+            interior = _step(s)
+            fields = g.wait_remote_neighbor_copy_updates(fields, handle)
+            return {**interior, **fields, _field: interior[_field]}
+
+        rec = {
+            "eager_step_s": round(median_step(step_e, state_e), 6),
+            "host_split_step_s": round(median_step(step_split, state_e),
+                                       6),
+            "fused_step_s": round(median_step(step_f, state_f), 6),
+        }
+        rec["fused_vs_eager"] = round(
+            rec["eager_step_s"] / max(rec["fused_step_s"], 1e-12), 3
+        )
+        if profile:
+            rec["overlap_fraction"] = measured_overlap(
+                step_f, state_f, model
+            )
+        out["models"][model] = rec
+    return out
+
+
+def bench_halo_overlap(steps: int = 20, length: int = 8):
+    """Print the :func:`halo_overlap_summary` sweep as a bench metric:
+    value = the worst fused-vs-eager step ratio across models (>= 1.0
+    means the fused split-phase step regressed nothing)."""
+    s = halo_overlap_summary(steps=steps, length=length)
+    ratios = [m["fused_vs_eager"] for m in s["models"].values()]
+    print(json.dumps({
+        "metric": "halo_overlap_fused_vs_eager",
+        "value": round(min(ratios), 3),
+        "unit": "x (eager/fused step latency, worst model)",
+        "detail": s,
+    }))
+
+
 def pic_setup(n_particles: int, length: int = 32, *, max_ref: int = 0,
               refine_ball: float | None = None,
               balance_method: str | None = None, seed: int = 0):
@@ -575,6 +747,7 @@ def main():
     bench_epoch_rebuild()
     bench_epoch_churn(args.churn_length)
     bench_churn_compile()
+    bench_halo_overlap()
     bench_particles(args.particles)
 
 

@@ -126,6 +126,75 @@ def build_face_tables(grid, hood_id, tables, dtype):
     return host, dev
 
 
+def build_split_tables(grid, hood_id, host_face, dtype, extra=None):
+    """Compacted inner/outer row sets with the gather + face tables
+    restricted to them — the runtime-argument pack of a fused
+    split-phase step (shared by Advection and Vlasov).
+
+    ``host_face`` is the host dict :func:`build_face_tables` returned;
+    ``extra`` maps names to additional ``[D, R]`` host tables restricted
+    per side and shipped at ``dtype`` (Vlasov's open-boundary face
+    areas).  Returns ``(inner, outer, local)`` device pytrees; padding
+    rows point at the scratch row, whose face entries are all masked
+    (``face_dir == 0``), so padded lanes contribute exactly nothing."""
+    from ..parallel.shapes import bucket_rows
+    from ..parallel.stencil import compact_rows
+
+    epoch = grid.epoch
+    hood = epoch.hoods[hood_id]
+    scratch = epoch.R - 1
+    D = epoch.n_devices
+    ar = np.arange(D)[:, None]
+    mesh = grid.mesh
+    put = lambda a, dt=None: put_table(a, mesh, dt)
+    # compacted widths ride the bucket ladder with grid-persistent
+    # hysteresis hints (the ring-size discipline of parallel/shapes.py):
+    # inner/outer counts wiggling with churn must not retrace the fused
+    # split kernels — pad slots are scratch rows whose face entries are
+    # all masked, so they contribute exactly nothing
+    hints = getattr(grid, "_ring_hints", {})
+    sides = []
+    for side, mask in (("inner", hood.inner_mask),
+                       ("outer", hood.outer_mask)):
+        counts = mask.sum(axis=1)
+        natural = max(int(counts.max()) if D else 0, 1)
+        hint_key = (hood_id, f"split.{side}", 0)
+        W = bucket_rows(natural, hints.get(hint_key))
+        hints[hint_key] = W
+        rows = compact_rows(mask, scratch, width=W)
+        fd = host_face["face_dir"][ar, rows]
+        sub = {
+            "rows": put(rows),
+            "nbr_rows": put(hood.nbr_rows[ar, rows]),
+            "face_dir": put(fd, jnp.int8),
+            "axis_idx": put(
+                np.maximum(np.abs(fd.astype(np.int64)) - 1, 0), jnp.int8
+            ),
+            "min_area": put(host_face["min_area"][ar, rows], dtype),
+            "cell_axis_len": put(
+                host_face["cell_axis_len"][ar, rows], dtype
+            ),
+            "nbr_axis_len": put(host_face["nbr_axis_len"][ar, rows], dtype),
+            "inv_volume": put(host_face["inv_volume"][ar, rows], dtype),
+        }
+        for name, arr in (extra or {}).items():
+            sub[name] = put(arr[ar, rows], dtype)
+        sides.append(sub)
+    return sides[0], sides[1], put(epoch.local_mask)
+
+
+def _table_specs(tabs):
+    """shard_map in_specs pytree for a split-table pack: every leaf is a
+    ``[D, ...]`` array sharded on the device axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import SHARD_AXIS
+
+    return jax.tree_util.tree_map(
+        lambda x: P(SHARD_AXIS, *([None] * (x.ndim - 1))), tabs
+    )
+
+
 def _ml_boxed_edge(kind: str) -> float:
     """Multi-level (3+ level) whole-run edge, per FORM: the
     VMEM-resident Pallas kernel and the streaming XLA pyramid have
@@ -153,13 +222,20 @@ class Advection:
     }
 
     def __init__(self, grid, hood_id=None, dtype=np.float64, allow_dense=True,
-                 use_pallas=True, allow_boxed=True):
+                 use_pallas=True, allow_boxed=True, overlap=False):
         self.grid = grid
         self.hood_id = hood_id
         self.dtype = dtype
         self.use_pallas = use_pallas
+        #: split-phase stepping (ISSUE 7): ``step``/``run`` use the fused
+        #: start → interior → finish → boundary body on the general
+        #: gather path, bit-identical to the blocking step.  Like GoL's
+        #: ``overlap=True``, this pins the general path (the split form
+        #: exists to overlap the halo seam the fast paths do not have).
+        self.overlap = bool(overlap)
         self.spec = {k: (s, dtype) for k, (s, _) in self.SPEC.items()}
-        self.dense = grid.epoch.dense if allow_dense else None
+        self.dense = (grid.epoch.dense if allow_dense and not overlap
+                      else None)
         self.boxed = None
         if self.dense is not None:
             self._init_dense()
@@ -175,7 +251,9 @@ class Advection:
         self._step = self._build_step()
         self._max_dt = self._build_max_dt()
         self._max_diff = self._build_max_diff()
-        if allow_boxed:
+        if self.overlap:
+            self._step = self._build_split_step()
+        if allow_boxed and not self.overlap:
             from ..parallel.boxed import build_boxed
 
             self.boxed = build_boxed(grid, hood_id)
@@ -287,6 +365,125 @@ class Advection:
         self._step_fn = fn
         rings, t, dev = self._rings, self.tables.tree(), self._dev
         return lambda state, dt: fn(rings, t, dev, state, dt)
+
+    def _build_split_step(self):
+        """Fused split-phase step (ISSUE 7; the reference's
+        ``dccrg.hpp:5010-5367`` overlap pattern as ONE compiled
+        program): dispatch the ghost payloads, compute the flux of the
+        compacted inner rows with no data dependence on the transfer,
+        merge the ghosts (the wait), then the outer rows.  The XLA
+        scheduler — or the Pallas DMA engine when the halo backend is
+        ``pallas`` — overlaps the transfer with interior compute without
+        relying on host async dispatch.
+
+        Bit-identical to the blocking step: inner rows gather only local
+        rows, which the exchange never writes, and invalid-slot gathers
+        (scratch-row padding the exchange DOES write) are masked by
+        ``face_dir == 0`` in both forms before the ordered reduction."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.exec_cache import traced_jit
+        from ..parallel.halo import HaloExchange
+        from ..parallel.mesh import SHARD_AXIS
+        from ..utils.compat import shard_map
+
+        ex = self._exchange
+        host_face = {
+            "face_dir": self.face_dir,
+            "min_area": self.min_area,
+            "cell_axis_len": self.cell_axis_len,
+            "nbr_axis_len": self.nbr_axis_len,
+            "inv_volume": self.inv_volume,
+        }
+        inner, outer, local = build_split_tables(
+            self.grid, self.hood_id, host_face, self.dtype
+        )
+        ring_start = ex.make_ring_start()
+        mesh = self.grid.mesh
+        ks = tuple(ex.ring_ks)
+
+        def build():
+            nk = len(ks)
+            data_spec = P(SHARD_AXIS)
+            idx_spec = P(SHARD_AXIS, None)
+
+            def side_update(rho, vx, vy, vz, t, dt):
+                # the blocking step's flux math verbatim, restricted to
+                # one compacted row set (same ops, same slot order —
+                # that is the bit-identity argument)
+                rows = t["rows"]
+                rho_c = rho[rows]                            # [W]
+                nbr = t["nbr_rows"]
+                rho_n = rho[nbr]                             # [W, K]
+                vx_n, vy_n, vz_n = vx[nbr], vy[nbr], vz[nbr]
+                sgn = jnp.sign(t["face_dir"]).astype(rho.dtype)
+                ai = t["axis_idx"]
+                v_cell = jnp.where(
+                    ai == 0, vx[rows][..., None],
+                    jnp.where(ai == 1, vy[rows][..., None],
+                              vz[rows][..., None]),
+                )
+                v_nbr = jnp.where(
+                    ai == 0, vx_n, jnp.where(ai == 1, vy_n, vz_n)
+                )
+                cl, nl = t["cell_axis_len"], t["nbr_axis_len"]
+                v_face = (cl * v_nbr + nl * v_cell) / (cl + nl)
+                upwind_pos = jnp.where(v_face >= 0, rho_c[..., None], rho_n)
+                upwind_neg = jnp.where(v_face >= 0, rho_n, rho_c[..., None])
+                upwind = jnp.where(sgn > 0, upwind_pos, upwind_neg)
+                face_flux = upwind * dt * v_face * t["min_area"]
+                contrib = jnp.where(
+                    t["face_dir"] != 0, -sgn * face_flux, 0.0
+                )
+                return rho_c + ordered_sum(contrib, axis=-1) * t["inv_volume"]
+
+            def body(*args):
+                sends = [a[0] for a in args[:nk]]
+                recvs = [a[0] for a in args[nk:2 * nk]]
+                ti, to, local, rho, vx, vy, vz, dt = args[2 * nk:]
+                sub = lambda t: {k: v[0] for k, v in t.items()}
+                ti, to = sub(ti), sub(to)
+                a = rho[0]
+                vx, vy, vz = vx[0], vy[0], vz[0]
+                # --- start: ghost payloads in flight (depend on `a`)
+                payloads = ring_start(a, sends)
+                # --- interior: no remote neighbors, no dep on payloads
+                new_i = side_update(a, vx, vy, vz, ti, dt)
+                # --- wait: merging the payloads IS the synchronization
+                a2 = HaloExchange.ring_finish(a, recvs, payloads)
+                # --- boundary: needs the fresh ghosts
+                new_o = side_update(a2, vx, vy, vz, to, dt)
+                out = a2.at[ti["rows"]].set(new_i).at[to["rows"]].set(new_o)
+                out = jnp.where(local[0], out, a2)       # clean scratch
+                return out[None]
+
+            fn = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(idx_spec,) * (2 * nk)
+                + (_table_specs(inner), _table_specs(outer), idx_spec)
+                + (data_spec,) * 4 + (P(),),
+                out_specs=data_spec,
+                check_vma=False,
+            )
+
+            def step(rings, ti, to, local, state, dt):
+                new_rho = fn(
+                    *rings, ti, to, local, state["density"], state["vx"],
+                    state["vy"], state["vz"], dt,
+                )
+                return {**state, "density": new_rho,
+                        "flux": jnp.zeros_like(new_rho)}
+
+            return traced_jit("advection.split_step", step)
+
+        fn = self.grid.exec_cache.get(
+            self._kernel_key("advection.split_step"), build
+        )
+        self._split_fn = fn
+        self._split_args = (self._rings, inner, outer, local)
+        args = self._split_args
+        return lambda state, dt: fn(*args, state, dt)
 
     def _build_max_dt(self):
         from ..parallel.exec_cache import traced_jit
@@ -1012,7 +1209,30 @@ class Advection:
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if not hasattr(self, "_run"):
-            if hasattr(self, "_step_fn"):
+            if getattr(self, "_split_fn", None) is not None:
+                from ..parallel.exec_cache import traced_jit
+
+                inner = self._split_fn
+
+                def build():
+                    def run_fn(rings, ti, to, local, state, steps, dt):
+                        return jax.lax.fori_loop(
+                            0, steps,
+                            lambda i, st: inner(rings, ti, to, local, st,
+                                                dt),
+                            state,
+                        )
+
+                    return traced_jit("advection.split_run", run_fn)
+
+                fn = self.grid.exec_cache.get(
+                    self._kernel_key("advection.split_run"), build
+                )
+                args = self._split_args
+                self._run = lambda state, steps, dt: fn(
+                    *args, state, steps, dt
+                )
+            elif hasattr(self, "_step_fn"):
                 from ..parallel.exec_cache import traced_jit
 
                 inner = self._step_fn
@@ -1046,7 +1266,8 @@ class Advection:
                     )
 
                 self._run = run_fn
-        self._record_run("general", steps, state)
+        self._record_run("split" if self.overlap else "general",
+                         steps, state)
         return self._run(state, steps, jnp.asarray(dt, self.dtype))
 
     def max_time_step(self, state) -> float:

@@ -131,6 +131,8 @@ class GameOfLife:
 
         from ..parallel.mesh import SHARD_AXIS, put_table, shard_spec
 
+        from ..parallel.shapes import bucket_rows
+
         grid = self.grid
         epoch = grid.epoch
         hood = epoch.hoods[self.hood_id]
@@ -138,8 +140,20 @@ class GameOfLife:
         scratch = epoch.R - 1
         D = epoch.n_devices
         ar = np.arange(D)[:, None]
-        irows = compact_rows(hood.inner_mask, scratch)       # [D, Wi]
-        orows = compact_rows(hood.outer_mask, scratch)       # [D, Wo]
+        # compacted widths ride the bucket ladder with grid-persistent
+        # hints (see models/advection.py build_split_tables): churn must
+        # not retrace the fused body while the signature holds
+        hints = getattr(grid, "_ring_hints", {})
+
+        def rows_of(side, mask):
+            natural = max(int(mask.sum(axis=1).max()) if D else 0, 1)
+            key = (self.hood_id, f"split.{side}", 0)
+            W = bucket_rows(natural, hints.get(key))
+            hints[key] = W
+            return compact_rows(mask, scratch, width=W)
+
+        irows = rows_of("inner", hood.inner_mask)            # [D, Wi]
+        orows = rows_of("outer", hood.outer_mask)            # [D, Wo]
         # gather tables restricted to the compacted row sets
         nri, nvi = hood.nbr_rows[ar, irows], hood.nbr_valid[ar, irows]
         nro, nvo = hood.nbr_rows[ar, orows], hood.nbr_valid[ar, orows]
@@ -149,13 +163,15 @@ class GameOfLife:
         local = put(epoch.local_mask)
         rings = tuple(halo.ring_send) + tuple(halo.ring_recv)
         ks = tuple(halo.ring_ks)
+        # backend-selected transport (collective ppermute or Pallas
+        # async-DMA ring), a pure function of halo.structure_key
+        ring_start = halo.make_ring_start()
 
         from ..parallel.exec_cache import traced_jit
         from ..parallel.halo import HaloExchange
 
         def build():
             nk = len(ks)
-            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
             data_spec = P(SHARD_AXIS)
             rule = _life_rule
 
@@ -168,8 +184,8 @@ class GameOfLife:
                     args[2 * nk:]
                 )
                 a = alive[0]                                     # [R]
-                # --- start: ghost payload collectives (depend on `a`)
-                payloads = HaloExchange.ring_start(a, perms, sends)
+                # --- start: ghost payloads in flight (depend on `a`)
+                payloads = ring_start(a, sends)
                 # --- inner compute: no remote neighbors, no dep on
                 # payloads
                 cnt_i = jnp.sum(
@@ -212,10 +228,8 @@ class GameOfLife:
 
             return traced_jit("gol.overlap_step", step)
 
-        from ..parallel.exec_cache import mesh_key
-
         fn = self.grid.exec_cache.get(
-            ("gol.overlap_step", mesh_key(mesh), D, ks), build
+            ("gol.overlap_step", halo.structure_key), build
         )
 
         def step(state):

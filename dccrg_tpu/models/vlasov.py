@@ -44,9 +44,15 @@ __all__ = ["Vlasov"]
 
 class Vlasov:
     def __init__(self, grid, nv: int = 4, v_max: float = 1.0,
-                 dtype=np.float32, use_pallas=True):
+                 dtype=np.float32, use_pallas=True, overlap: bool = False):
         self.grid = grid
-        self.info = grid.epoch.dense
+        #: split-phase stepping (ISSUE 7): run the general gather-path
+        #: update as the fused start → interior → finish → boundary body.
+        #: Forces the row layout even on slab grids — the split form
+        #: exists to overlap the halo seam, which the dense ring hides
+        #: inside its own shard_map.
+        self.overlap = bool(overlap)
+        self.info = grid.epoch.dense if not overlap else None
         self.nv = nv
         self.v_max = float(v_max)
         self.B = nv**3
@@ -249,7 +255,7 @@ class Vlasov:
         dtype = self.dtype
         self.tables = StencilTables(grid, None, with_geometry=True)
         self._exchange = grid.halo(None)
-        _host, dev = build_face_tables(grid, None, self.tables, dtype)
+        host_face, dev = build_face_tables(grid, None, self.tables, dtype)
         t = self.tables.tree()
 
         # open-boundary face areas per cell per axis/side: the dense
@@ -352,6 +358,128 @@ class Vlasov:
         self._run = self._run_xla = (
             lambda state, steps, dt: run_fn(*args, state, steps, dt)
         )
+        if self.overlap:
+            # the eager kernels above stay on _step_xla/_run_xla (the
+            # in-process oracle); step()/run() take the fused split form
+            self._build_split_general(host_face, bnd_pos, bnd_neg,
+                                      has_open)
+
+    def _build_split_general(self, host_face, bnd_pos, bnd_neg, has_open):
+        """Fused split-phase step on the row layout (ISSUE 7): halo
+        start → interior bins (compacted inner rows, no data dependence
+        on the in-flight f blocks) → ghost merge → boundary bins.  The
+        flux math is the eager general step's verbatim, restricted per
+        row set — see Advection._build_split_step for the bit-identity
+        argument (invalid slots masked by ``face_dir == 0``)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.exec_cache import traced_jit
+        from ..parallel.halo import HaloExchange
+        from ..parallel.stencil import ordered_sum
+        from ..utils.compat import shard_map
+        from .advection import _table_specs, build_split_tables
+
+        grid = self.grid
+        dtype = self.dtype
+        extra = {}
+        for d3 in range(3):
+            extra[f"bnd_pos{d3}"] = bnd_pos[d3]
+            extra[f"bnd_neg{d3}"] = bnd_neg[d3]
+        inner, outer, local = build_split_tables(
+            grid, None, host_face, dtype, extra=extra
+        )
+        ex = self._exchange
+        ring_start = ex.make_ring_start()
+        ks = tuple(ex.ring_ks)
+        mesh = grid.mesh
+        rings = tuple(ex.ring_send) + tuple(ex.ring_recv)
+
+        def build():
+            nk = len(ks)
+            data_spec = P(SHARD_AXIS)
+            idx_spec = P(SHARD_AXIS, None)
+
+            def side_update(f, t, vbT, dt):
+                rows = t["rows"]
+                f_c = f[rows]                               # [W, B]
+                f_n = f[t["nbr_rows"]]                      # [W, K, B]
+                sgn = jnp.sign(t["face_dir"]).astype(f.dtype)[..., None]
+                ai = t["axis_idx"].astype(jnp.int32)
+                v_face = vbT[ai]                            # [W, K, B]
+                fc = f_c[:, None, :]
+                up_pos = jnp.where(v_face >= 0, fc, f_n)
+                up_neg = jnp.where(v_face >= 0, f_n, fc)
+                upwind = jnp.where(sgn > 0, up_pos, up_neg)
+                face_flux = (upwind * (dt * v_face)
+                             * t["min_area"][..., None])
+                contrib = jnp.where(
+                    (t["face_dir"] != 0)[..., None], -sgn * face_flux,
+                    0.0,
+                )
+                total = ordered_sum(contrib, axis=-2)
+                if has_open:
+                    rate = sum(
+                        t[f"bnd_pos{d3}"][..., None]
+                        * jnp.maximum(vbT[d3], 0)
+                        + t[f"bnd_neg{d3}"][..., None]
+                        * jnp.maximum(-vbT[d3], 0)
+                        for d3 in range(3)
+                    )
+                    total = total - dt * f_c * rate
+                return f_c + total * t["inv_volume"][..., None]
+
+            def body(*args):
+                sends = [a[0] for a in args[:nk]]
+                recvs = [a[0] for a in args[nk:2 * nk]]
+                ti, to, local, vbT, f, dt = args[2 * nk:]
+                sub = lambda t: {k: v[0] for k, v in t.items()}
+                ti, to = sub(ti), sub(to)
+                fb = f[0]
+                payloads = ring_start(fb, sends)
+                new_i = side_update(fb, ti, vbT, dt)
+                f2 = HaloExchange.ring_finish(fb, recvs, payloads)
+                new_o = side_update(f2, to, vbT, dt)
+                out = f2.at[ti["rows"]].set(new_i).at[to["rows"]].set(new_o)
+                out = jnp.where(local[0][..., None], out, f2)
+                return out[None]
+
+            fn = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(idx_spec,) * (2 * nk)
+                + (_table_specs(inner), _table_specs(outer), idx_spec,
+                   P())
+                + (data_spec, P()),
+                out_specs=data_spec,
+                check_vma=False,
+            )
+
+            def step(rings, ti, to, local, vbT, state, dt):
+                return {**state, "f": fn(*rings, ti, to, local, vbT,
+                                         state["f"], dt)}
+
+            step_k = traced_jit("vlasov.split_step", step)
+
+            def run(rings, ti, to, local, vbT, state, steps, dt):
+                dt_ = jnp.asarray(dt, dtype)
+                return jax.lax.fori_loop(
+                    0, steps,
+                    lambda i, st: step_k(rings, ti, to, local, vbT, st,
+                                         dt_),
+                    state,
+                )
+
+            return step_k, traced_jit("vlasov.split_run", run)
+
+        step_fn, run_fn = self.grid.exec_cache.get(
+            ("vlasov.split_step", ex.structure_key, str(np.dtype(dtype)),
+             has_open), build
+        )
+        vbT = jnp.asarray(self.v_bins.T, dtype)
+        args = (rings, inner, outer, local, vbT)
+        self._step = lambda state, dt: step_fn(*args, state, dt)
+        self._run = lambda state, steps, dt: run_fn(*args, state, steps,
+                                                    dt)
 
     # ------------------------------------------------------------ user API
 
@@ -425,8 +553,11 @@ class Vlasov:
                 "fused Vlasov kernel", self._run, self._run_xla,
                 self._disable_fused, state, steps, dt,
             )
-        self._record_run("xla" if self.info is not None else "general",
-                         steps, state)
+        self._record_run(
+            "xla" if self.info is not None
+            else ("split" if self.overlap else "general"),
+            steps, state,
+        )
         return self._run(state, steps, dt)
 
     def max_time_step(self) -> float:

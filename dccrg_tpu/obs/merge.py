@@ -317,7 +317,7 @@ class MergedTrace:
                         "open_host_phases": open_phases})
         return out
 
-    def record_gauges(self, registry=None) -> dict:
+    def record_gauges(self, registry=None, extra_labels=None) -> dict:
         """Register the measured plane into the metrics registry:
         ``overlap.fraction{phase=halo}``,
         ``device.busy_fraction{device=d}`` and the per-kernel
@@ -325,14 +325,21 @@ class MergedTrace:
         summary the gauges came from.  Recorded only from evidence — a
         deviceless round registers nothing (the documented no-op), so a
         gate requiring the gauges fails exactly when evidence went
-        missing."""
+        missing.
+
+        ``extra_labels`` adds labels to the overlap gauge only (a probe
+        profiling one model's split-phase drive records
+        ``overlap.fraction{model=..., phase=halo}`` — the per-model
+        series ``telemetry_diff``'s floor gate watches, ISSUE 7);
+        per-device busy and per-kernel attribution stay global."""
         reg = registry if registry is not None else metrics
         s = self.summary()
         if not s["device_evidence"]:
             return s
         frac = s["overlap"]["halo"]["fraction"]
         if frac is not None:
-            reg.gauge("overlap.fraction", frac, phase="halo")
+            reg.gauge("overlap.fraction", frac, phase="halo",
+                      **(extra_labels or {}))
         for dev, rec in s["devices"].items():
             reg.gauge("device.busy_fraction", rec["fraction"], device=dev)
         for label, rec in s["kernels"].items():
@@ -521,7 +528,8 @@ def build_from_capture(ingest_or_dir) -> MergedTrace:
 
 def merge_profile(log_dir: str, timeline: EventTimeline | None = None,
                   out_path: str | None = None, registry=None,
-                  out_max_spans: int | None = None):
+                  out_max_spans: int | None = None,
+                  extra_labels: dict | None = None):
     """One-call round: ingest ``log_dir``, align, merge with the (default)
     host timeline, record the overlap/busy/attribution gauges, and
     optionally export the merged trace.  Returns ``(merged, summary)``.
@@ -534,7 +542,7 @@ def merge_profile(log_dir: str, timeline: EventTimeline | None = None,
         ing = _xp.ingest(log_dir)
     with reg.phase("trace.merge"):
         merged = build_merged(ingest=ing, timeline=timeline)
-    summary = merged.record_gauges(registry)
+    summary = merged.record_gauges(registry, extra_labels=extra_labels)
     if out_path is not None:
         merged.export(out_path, max_spans_per_device=out_max_spans)
     return merged, summary

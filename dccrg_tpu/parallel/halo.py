@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..utils.compat import shard_map
 
 from ..obs.registry import metrics as _metrics
+from . import halo_dma
 from .exec_cache import ExecutableCache, mesh_key as _mesh_key, traced_jit
 from .mesh import SHARD_AXIS, put_table
 from .shapes import bucket_pairs
@@ -136,6 +137,16 @@ class HaloExchange:
         #: with churn must not flap the per-distance table shapes, or
         #: every kernel taking the schedule as an argument retraces
         self._ring_hints = ring_hints if ring_hints is not None else {}
+        #: wire transport the compiled bodies use (``DCCRG_HALO_BACKEND``):
+        #: ``collective`` rides ``lax.ppermute``; ``pallas`` rides the
+        #: async-DMA ring kernels (``parallel/halo_dma.py``), under the
+        #: interpreter on non-TPU backends.  Part of ``structure_key``, so
+        #: every cached body (and every model kernel keyed on it) is
+        #: compiled per transport.
+        self.backend = halo_dma.resolve_backend()
+        self._interpret = halo_dma.interpret_mode()
+        if _metrics.enabled:
+            _metrics.inc("halo.backend_schedules", backend=self.backend)
         #: cells moved per exchange (useful payload, for bandwidth
         #: accounting)
         self.cells_moved = int(hood.pair_counts.sum())
@@ -303,9 +314,28 @@ class HaloExchange:
     @property
     def structure_key(self) -> tuple:
         """Everything the compiled bodies' traces depend on besides
-        argument shapes: the mesh and the active ring distances.  Model
-        kernels mix this into their own cache keys."""
-        return (_mesh_key(self.mesh), self.D, tuple(self.ring_ks))
+        argument shapes: the mesh, the active ring distances and the
+        wire transport.  Model kernels mix this into their own cache
+        keys — so a backend flip re-keys every composed program too."""
+        return (_mesh_key(self.mesh), self.D, tuple(self.ring_ks),
+                self.backend)
+
+    def make_ring_start(self):
+        """The backend-selected in-flight payload producer: a function
+        ``(blk, send_tabs) -> [payload_k, ...]`` to call INSIDE a
+        shard_map body.  Fused split-phase model kernels inline it
+        between their halo dispatch and ghost-row scatter; it is a pure
+        function of :attr:`structure_key`, so cached kernels closing
+        over it stay valid across epoch rebuilds that keep the
+        signature."""
+        D, ks = self.D, tuple(self.ring_ks)
+        if self.backend == "pallas":
+            interpret = self._interpret
+            return lambda blk, sends: halo_dma.ring_dma_start(
+                blk, ks, D, sends, interpret=interpret
+            )
+        perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+        return lambda blk, sends: HaloExchange.ring_start(blk, perms, sends)
 
     @property
     def raw_body(self):
@@ -316,17 +346,35 @@ class HaloExchange:
         return self._fn
 
     def _build(self):
+        return self._build_body(self.backend)
+
+    def _build_body(self, backend: str):
+        """The compiled blocking-exchange body for one transport.  The
+        selected backend's body is the dispatch path; the collective
+        body doubles as the always-available bit-identity oracle
+        (``DCCRG_HALO_VERIFY=1`` builds it on demand even when the
+        pallas body is live)."""
         mesh = self.mesh
         D = self.D
         ks = tuple(self.ring_ks)
+        interpret = self._interpret
 
         def build():
             nk = len(ks)
+            label = "halo.dma.body" if backend == "pallas" else "halo.body"
             if nk == 0:
                 # no cross-device pairs (single device, or fully local
                 # neighborhood): the exchange is the identity
-                return traced_jit("halo.body", lambda *args: args[-1])
-            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+                return traced_jit(label, lambda *args: args[-1])
+            if backend == "pallas":
+                ring = lambda blk, sends: halo_dma.ring_dma_start(
+                    blk, ks, D, sends, interpret=interpret
+                )
+            else:
+                perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+                ring = lambda blk, sends: HaloExchange.ring_start(
+                    blk, perms, sends
+                )
             data_spec = P(SHARD_AXIS)
             idx_spec = P(SHARD_AXIS, None)
 
@@ -337,7 +385,7 @@ class HaloExchange:
 
                 def exchange_leaf(x):
                     blk = x[0]                             # [R, ...]
-                    payloads = HaloExchange.ring_start(blk, perms, sends)
+                    payloads = ring(blk, sends)
                     return HaloExchange.ring_finish(
                         blk, recvs, payloads
                     )[None]
@@ -356,9 +404,11 @@ class HaloExchange:
             # controllers' devices is rejected under multi-process SPMD —
             # and argument tables are what lets the cached body outlive
             # the epoch that built this schedule
-            return traced_jit("halo.body", fn)
+            return traced_jit(label, fn)
 
-        return self._cache.get(("halo.body",) + self.structure_key, build)
+        return self._cache.get(
+            ("halo.body", _mesh_key(mesh), D, ks, backend), build
+        )
 
     def _selective(self, names: tuple):
         """Compiled per-field exchange for a cell_datatype policy: each
@@ -465,8 +515,11 @@ class HaloExchange:
             t0 = time.perf_counter()
             out = self._dispatch(state)
             _metrics.phase_add("halo.exchange", time.perf_counter() - t0)
-            return out
-        return self._dispatch(state)
+        else:
+            out = self._dispatch(state)
+        if self._verify_active(state):
+            self._verify_oracle(state, out)
+        return out
 
     def _dispatch(self, state):
         if self._cell_datatype is None:
@@ -475,6 +528,45 @@ class HaloExchange:
         block, _start, _finish, tab_args = self._selective(names)
         outs = block(*tab_args, *(state[n] for n in names))
         return {**state, **dict(zip(names, outs))}
+
+    # --------------------------------------------------- oracle verify
+
+    def _verify_active(self, state) -> bool:
+        """Whether this dispatch should replay on the collective oracle
+        (``DCCRG_HALO_VERIFY=1``): only meaningful off the collective
+        backend, only for the full-payload schedule (the policy-filtered
+        path is collective-only), and never inside someone else's trace
+        — the comparison is a host-side byte equality."""
+        return (
+            self.backend != "collective"
+            and self._cell_datatype is None
+            and halo_dma.verify_enabled()
+            and not _tracing(state)
+        )
+
+    def _verify_oracle(self, state, out) -> int:
+        """Cross-check one exchange against the collective oracle,
+        bit-for-bit (byte compare — NaN payloads included, so a
+        ``halo.nan`` storm verifies too).  Mismatching leaves are
+        counted (``halo.verify_mismatches{field}``), never raised: the
+        oracle is a detector the telemetry gates watch, not an
+        assertion.  Returns the mismatch count (tests read it
+        directly)."""
+        t0 = time.perf_counter()
+        oracle = self._build_body("collective")
+        ref = oracle(*self.ring_send, *self.ring_recv, state)
+        names = sorted(state) if isinstance(state, dict) else None
+        out_l = jax.tree_util.tree_leaves(out)
+        ref_l = jax.tree_util.tree_leaves(ref)
+        mismatches = 0
+        for i, (a, b) in enumerate(zip(out_l, ref_l)):
+            if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+                mismatches += 1
+                labels = {"field": names[i]} if names else {}
+                _metrics.inc("halo.verify_mismatches", **labels)
+        _metrics.inc("halo.verify_checks", len(out_l))
+        _metrics.phase_add("halo.verify", time.perf_counter() - t0)
+        return mismatches
 
     # ------------------------------------------------------- telemetry
 
@@ -591,20 +683,35 @@ class HaloExchange:
         mesh = self.mesh
         D = self.D
         ks = tuple(self.ring_ks)
+        backend = self.backend
+        interpret = self._interpret
 
         def build():
             nk = len(ks)
+            start_label = ("halo.dma.start" if backend == "pallas"
+                           else "halo.start")
             if nk == 0:
                 return (
                     traced_jit(
-                        "halo.start",
+                        start_label,
                         lambda state: jax.tree_util.tree_map(
                             lambda x: (), state
                         ),
                     ),
                     traced_jit("halo.finish", lambda state, payload: state),
                 )
-            perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+            if backend == "pallas":
+                # the DMA transfer completes inside the ring kernel; the
+                # returned payloads are therefore already landed, and the
+                # finish scatter below remains the program-level wait
+                ring = lambda blk, sends: halo_dma.ring_dma_start(
+                    blk, ks, D, sends, interpret=interpret
+                )
+            else:
+                perms = [[(d, (d + k) % D) for d in range(D)] for k in ks]
+                ring = lambda blk, sends: HaloExchange.ring_start(
+                    blk, perms, sends
+                )
             data_spec = P(SHARD_AXIS)
             idx_spec = P(SHARD_AXIS, None)
 
@@ -613,8 +720,7 @@ class HaloExchange:
                 state = args[nk]
                 return jax.tree_util.tree_map(
                     lambda x: tuple(
-                        p[None]
-                        for p in HaloExchange.ring_start(x[0], perms, sends)
+                        p[None] for p in ring(x[0], sends)
                     ),
                     state,
                 )
@@ -645,7 +751,7 @@ class HaloExchange:
                 out_specs=data_spec,
                 check_vma=False,
             )
-            return (traced_jit("halo.start", start),
+            return (traced_jit(start_label, start),
                     traced_jit("halo.finish", finish))
 
         self._start_fn, self._finish_fn = self._cache.get(
@@ -690,8 +796,13 @@ class HaloExchange:
             t0 = time.perf_counter()
             out = self._finish_dispatch(state, handle)
             _metrics.phase_add("halo.exchange", time.perf_counter() - t0)
-            return out
-        return self._finish_dispatch(state, handle)
+        else:
+            out = self._finish_dispatch(state, handle)
+        if self._verify_active(state):
+            # the handle came from start(state) on this same state, so
+            # the blocking oracle on `state` is the expected merge
+            self._verify_oracle(state, out)
+        return out
 
     def _finish_dispatch(self, state, handle: HaloHandle):
         if self._cell_datatype is not None:

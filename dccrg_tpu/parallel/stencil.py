@@ -18,14 +18,23 @@ from .mesh import put_table, shard_spec
 __all__ = ["StencilTables", "gather_neighbors", "compact_rows"]
 
 
-def compact_rows(mask: np.ndarray, scratch: int) -> np.ndarray:
+def compact_rows(mask: np.ndarray, scratch: int,
+                 width: int | None = None) -> np.ndarray:
     """Per-device padded row lists from a ``[D, R]`` bool mask: returns
     ``[D, W]`` int32 with each device's True rows first and the scratch row
     as padding.  The compacted form lets split-phase kernels compute
-    exactly the inner (or outer) cells instead of masking all R rows."""
+    exactly the inner (or outer) cells instead of masking all R rows.
+
+    ``width`` pads W up to a caller-chosen (e.g. bucket-laddered) value
+    so the row lists keep sticky shapes across churn; extra slots are
+    scratch-row padding like any other."""
     D, R = mask.shape
     counts = mask.sum(axis=1)
     W = max(int(counts.max()) if D else 0, 1)
+    if width is not None:
+        if width < W:
+            raise ValueError(f"width {width} below natural {W}")
+        W = width
     rows = np.full((D, W), scratch, dtype=np.int32)
     for d in range(D):
         rows[d, : counts[d]] = np.flatnonzero(mask[d])

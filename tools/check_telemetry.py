@@ -32,6 +32,13 @@ Checks (exit 1 on any failure):
   standalone via ``--validate-merged-trace``); captures with no
   execution lines (deviceless backends, ``DCCRG_XPLANE=0``) are the
   documented no-op;
+* a halo-backend round (ISSUE 7): a forced ``DCCRG_HALO_BACKEND=pallas``
+  + ``DCCRG_HALO_VERIFY=1`` grid runs blocking and split exchanges
+  through the async-DMA ring bodies (interpreted on CPU) and must leave
+  ``halo.verify_checks`` with zero ``halo.verify_mismatches``; the
+  profiled round additionally drives the fused split-phase advection and
+  vlasov steps and requires their per-model
+  ``overlap.fraction{model=..., phase=halo}`` gauges;
 * unless ``--skip-overhead``: enabling telemetry must not slow the
   workload's step loop by more than ``--threshold`` (default 1.05 =
   5%) vs the disabled mode — the zero-cost-when-disabled and
@@ -92,6 +99,11 @@ REQUIRED_NONZERO_COUNTERS = (
     # cache on its second cycle
     "epoch.recompiles",
     "epoch.cache_hits",
+    # ISSUE 7: the forced pallas-backend round must leave its oracle
+    # evidence — a verify round that silently checked nothing is a
+    # coverage loss, exactly like an uncounted injected fault
+    "halo.backend_schedules",
+    "halo.verify_checks",
 )
 
 
@@ -317,6 +329,60 @@ def drive_split(g, adv, state, dt, steps: int):
     return state
 
 
+def drive_fused(step_once, state, steps: int):
+    """Drive a FUSED split-phase step (ISSUE 7: advection/vlasov
+    ``overlap=True``, GoL's overlap step): the whole start → interior →
+    finish → boundary program is ONE dispatch, so the host-visible
+    in-flight window is dispatch → completion.  Each step stamps the
+    dispatch as a ``halo.start`` span and the completing sync as
+    ``halo.exchange`` — the window shape ``obs/merge.py`` pairs — so the
+    merged trace measures how much device compute the window hid.  (For
+    a fused step this window bounds the true in-flight interval from
+    above; the fraction is still a measured floor-gateable overlap
+    signal, not an inference.)"""
+    import jax
+
+    from dccrg_tpu import obs
+
+    for i in range(steps):
+        with obs.timeline.context(step=i):
+            t0 = time.perf_counter()
+            state = step_once(state)
+            obs.metrics.phase_add("halo.start", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(state)
+            obs.metrics.phase_add("halo.exchange",
+                                  time.perf_counter() - t0)
+    return state
+
+
+def build_fused_model(g, model: str):
+    """A fused split-phase stepper for one model on grid ``g``:
+    ``(step_once, state)``.  Shared by the device-timeline probe and
+    ``tools/trace_report.py --run --model``."""
+    import numpy as np
+
+    from dccrg_tpu.models import Advection, GameOfLife, Vlasov
+
+    if model == "advection":
+        adv = Advection(g, dtype=np.float32, allow_dense=False,
+                        overlap=True)
+        state = adv.initialize_state()
+        dt = np.float32(0.4 * adv.max_time_step(state))
+        return (lambda s: adv.step(s, dt)), state
+    if model == "vlasov":
+        vl = Vlasov(g, nv=2, dtype=np.float32, overlap=True)
+        state = vl.initialize_state()
+        dt = np.float32(0.5 * vl.max_time_step())
+        return (lambda s: vl.step(s, dt)), state
+    if model == "gol":
+        gol = GameOfLife(g, overlap=True)
+        cells = g.get_cells()
+        state = gol.new_state(alive_cells=cells[:: 3])
+        return gol.step, state
+    raise ValueError(f"unknown model {model!r}")
+
+
 def _resilience_probe(g, state) -> list:
     """Forced injection round (ISSUE 4): arm a bit flip, commit two
     lineage generations (one corrupt), and require the full detection
@@ -421,6 +487,70 @@ def _churn_probe(g, dt) -> list:
     return failures
 
 
+def _halo_backend_probe() -> list:
+    """Forced pallas-backend round (ISSUE 7): build a small multi-ring
+    grid with ``DCCRG_HALO_BACKEND=pallas`` + ``DCCRG_HALO_VERIFY=1``,
+    run blocking and split-phase exchanges through the async-DMA ring
+    bodies (interpreted on CPU), and require the oracle cross-check to
+    have fired with ZERO mismatches — the probe fails exactly when the
+    DMA transport stops being bit-identical to the collective path."""
+    import numpy as np
+
+    from dccrg_tpu import Grid, make_mesh, obs
+
+    failures: list = []
+    saved = {k: os.environ.get(k)
+             for k in ("DCCRG_HALO_BACKEND", "DCCRG_HALO_VERIFY")}
+    os.environ["DCCRG_HALO_BACKEND"] = "pallas"
+    os.environ["DCCRG_HALO_VERIFY"] = "1"
+    try:
+        g = (
+            Grid()
+            .set_initial_length((8, 8, 1))
+            .set_neighborhood_length(1)
+            .set_load_balancing_method("RCB")
+            .initialize(mesh=make_mesh())
+        )
+        if g.halo().backend != "pallas":
+            return ["halo backend probe: DCCRG_HALO_BACKEND=pallas did "
+                    f"not select the pallas transport "
+                    f"(got {g.halo().backend!r})"]
+        state = g.new_state({"v": ((), np.float64)})
+        cells = g.get_cells()
+        state = g.set_cell_data(
+            state, "v", cells, np.sin(cells.astype(np.float64))
+        )
+        state = g.update_copies_of_remote_neighbors(state)
+        handle = g.start_remote_neighbor_copy_updates(state)
+        g.wait_remote_neighbor_copy_updates(state, handle)
+        rep = obs.metrics.report()
+        checks = sum(rep["counters"].get("halo.verify_checks", {})
+                     .values())
+        if checks < 2:
+            failures.append(
+                f"halo backend probe: verify oracle ran {checks} "
+                "checks; the blocking + split round must cross-check "
+                "both"
+            )
+        mismatches = sum(rep["counters"]
+                         .get("halo.verify_mismatches", {}).values())
+        if mismatches:
+            failures.append(
+                f"halo backend probe: {mismatches} pallas/collective "
+                "mismatches — the DMA ring body is no longer "
+                "bit-identical to the oracle"
+            )
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"halo backend probe failed: {e!r}")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return failures
+
+
 def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
     """Profiled round (ISSUE 6): capture one split-phase drive under
     ``jax.profiler``, merge the xplane capture with the host timeline,
@@ -459,6 +589,22 @@ def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
               "(deviceless backend) — overlap/busy gauges not required",
               file=sys.stderr)
         return failures
+    # ISSUE 7: fused split-phase rounds — one compiled start → interior
+    # → finish → boundary program per model — must measure their own
+    # overlap, recorded per model so telemetry_diff's floor gate watches
+    # each series (not just the host-split GoL/advection drive above)
+    for model in ("advection", "vlasov"):
+        try:
+            step_once, mstate = build_fused_model(g, model)
+            mstate = drive_fused(step_once, mstate, 1)   # warm compiles
+            with tempfile.TemporaryDirectory() as td:
+                with obs.profile_trace(td):
+                    drive_fused(step_once, mstate, 4)
+                obs.merge_profile(td, extra_labels={"model": model})
+        except Exception as e:  # noqa: BLE001 — probe reports, not dies
+            failures.append(
+                f"fused split-phase {model} probe failed: {e!r}"
+            )
     rep = obs.metrics.report()
     gauges = rep["gauges"]
     frac = gauges.get("overlap.fraction", {}).get("phase=halo")
@@ -470,6 +616,21 @@ def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
             f"overlap.fraction{{phase=halo}} = {frac}: the split-phase "
             "probe must measure nonzero in-(0,1] overlap"
         )
+    for model in ("advection", "vlasov"):
+        mfrac = gauges.get("overlap.fraction", {}).get(
+            f"model={model},phase=halo"
+        )
+        if mfrac is None:
+            failures.append(
+                f"overlap.fraction{{model={model},phase=halo}} gauge "
+                "missing after the fused split-phase round"
+            )
+        elif not 0.0 < mfrac <= 1.0:
+            failures.append(
+                f"overlap.fraction{{model={model},phase=halo}} = "
+                f"{mfrac}: the fused round must measure nonzero "
+                "in-(0,1] overlap"
+            )
     if not gauges.get("device.busy_fraction"):
         failures.append("device.busy_fraction{device=d} gauges missing "
                         "after the profiled round")
@@ -525,6 +686,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
 
     failures += _resilience_probe(g, state)
     failures += _churn_probe(g, dt)
+    failures += _halo_backend_probe()
 
     if not skip_overhead:
         # measured BEFORE the profiled round: the xplane ingest/merge

@@ -201,6 +201,21 @@ print(json.dumps(out))
                  1500),
     "vlasov": ("import bench\nprint(json.dumps(bench.measure_vlasov()))",
                1500),
+    # ISSUE 7: the Pallas async-DMA halo transport vs the collective
+    # ring, oracle-verified on chip (the kernels CI only ever runs under
+    # the interpreter), and the fused split-phase steps vs their eager
+    # forms — the two measurements that turn the measured CPU overlap
+    # fractions into accelerator evidence when the tunnel returns
+    "halo_pallas_backend": ("""
+import bench
+out = bench.measure_halo_backends()
+print(json.dumps(out))
+""", 1500),
+    "fused_split_steps": ("""
+import bench
+out = bench.measure_split_fused()
+print(json.dumps(out))
+""", 1500),
     "large": ("import bench\nprint(json.dumps(bench.measure_large()))", 1500),
     "flat_kernel_sweep_Bvox_per_s": ("""
 import tools.flat_kernel_bench as fkb

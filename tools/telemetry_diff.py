@@ -155,13 +155,23 @@ DEFAULT_ALLOW = (
     "lineage.scan",
     "xplane.ingest",
     "trace.merge",
+    # ISSUE 7 halo-backend phase: the oracle cross-check replays every
+    # exchange on the collective path when DCCRG_HALO_VERIFY=1 — its
+    # cost scales with how many exchanges the round chose to verify,
+    # which is workload-shaped, not a perf regression
+    "halo.verify",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
 #: measured halo overlap fraction falling means communication stopped
 #: hiding under compute — exactly what the device-timeline plane exists
 #: to catch.  Engages only when both rounds carry the gauge (older
-#: rounds and deviceless backends pass vacuously).
+#: rounds and deviceless backends pass vacuously).  The floor applies
+#: PER LABELED SERIES, so the ISSUE 7 per-model gauges
+#: (``overlap.fraction{model=advection|vlasov, phase=halo}`` from the
+#: fused split-phase probe rounds) are each gated — and one going
+#: missing is a coverage loss — the moment a baseline round carries
+#: them.
 GATED_GAUGES_MIN = (
     "overlap.fraction",
 )

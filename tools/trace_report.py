@@ -16,8 +16,11 @@ prints the three answers a perf PR needs:
 Three input modes:
 
     python tools/trace_report.py --run             # self-contained probe:
-        profile one split-phase advection round in-process, full merge
-        (host timeline + device planes), report + gauges
+        profile one split-phase round in-process, full merge (host
+        timeline + device planes), report + gauges; --model picks the
+        drive (host-split advection, or the fused split-phase step of
+        gol/advection-fused/vlasov) and --halo-backend pins the halo
+        transport (ISSUE 7)
     python tools/trace_report.py LOGDIR            # post-hoc: an existing
         jax.profiler log dir; the host track is rebuilt from the capture's
         own TraceAnnotations (no live timeline needed)
@@ -54,21 +57,40 @@ def _ensure_env() -> None:
         ).strip()
 
 
-def run_probe(steps: int = 6):
-    """Profile one split-phase advection round in-process and return
-    ``(merged, summary)`` — the full live-host merge, gauges recorded."""
+def run_probe(steps: int = 6, model: str = "advection",
+              halo_backend: str | None = None):
+    """Profile one split-phase round in-process and return
+    ``(merged, summary)`` — the full live-host merge, gauges recorded.
+
+    ``model`` picks the drive: ``advection`` profiles the host-split
+    start/compute/wait loop (the source paper's pattern), while
+    ``advection-fused``, ``vlasov`` and ``gol`` profile the model's
+    FUSED split-phase step (one compiled start → interior → finish →
+    boundary program, ISSUE 7).  ``halo_backend`` exports
+    ``DCCRG_HALO_BACKEND`` before any schedule compiles, so any model's
+    overlap can be measured on either transport from the CLI."""
     from dccrg_tpu import obs
     import check_telemetry as ct
 
+    if halo_backend:
+        os.environ["DCCRG_HALO_BACKEND"] = halo_backend
     obs.enable()
     obs.enable_timeline()
     g, adv, state, dt = ct.build_workload()
-    state = ct.drive(g, adv, state, dt, 2)          # warm the compiles
-    state = ct.drive_split(g, adv, state, dt, 1)
+    if model == "advection":
+        state = ct.drive(g, adv, state, dt, 2)      # warm the compiles
+        state = ct.drive_split(g, adv, state, dt, 1)
+        with tempfile.TemporaryDirectory() as td:
+            with obs.profile_trace(td):
+                ct.drive_split(g, adv, state, dt, steps)
+            return obs.merge_profile(td)
+    name = "advection" if model == "advection-fused" else model
+    step_once, mstate = ct.build_fused_model(g, name)
+    mstate = ct.drive_fused(step_once, mstate, 1)   # warm the compiles
     with tempfile.TemporaryDirectory() as td:
         with obs.profile_trace(td):
-            ct.drive_split(g, adv, state, dt, steps)
-        return obs.merge_profile(td)
+            ct.drive_fused(step_once, mstate, steps)
+        return obs.merge_profile(td, extra_labels={"model": name})
 
 
 def report_record(merged, summary, top: int = 10,
@@ -147,6 +169,18 @@ def main(argv=None) -> int:
                          "in-process and report the live merge")
     ap.add_argument("--steps", type=int, default=6,
                     help="probe steps under --run")
+    ap.add_argument("--model",
+                    choices=("advection", "advection-fused", "gol",
+                             "vlasov"),
+                    default="advection",
+                    help="drive profiled under --run: 'advection' is "
+                         "the host-split loop; the others drive the "
+                         "model's fused split-phase step (ISSUE 7)")
+    ap.add_argument("--halo-backend", choices=("collective", "pallas",
+                                               "auto"),
+                    default=None,
+                    help="export DCCRG_HALO_BACKEND before the probe "
+                         "compiles its halo schedules")
     ap.add_argument("--fleet", nargs="+", default=None, metavar="TRACE",
                     help="merge per-process merged traces onto their "
                          "shared epoch-zero; write with --merged-out")
@@ -189,7 +223,8 @@ def main(argv=None) -> int:
         return 1 if failures else 0
 
     if args.run or args.log_dir is None:
-        merged, summary = run_probe(steps=args.steps)
+        merged, summary = run_probe(steps=args.steps, model=args.model,
+                                    halo_backend=args.halo_backend)
     else:
         from dccrg_tpu.obs.merge import build_from_capture
 
