@@ -1177,6 +1177,46 @@ def _attach_halo_overlap(record: dict) -> None:
         print(f"halo overlap probe failed: {e}", file=sys.stderr)
 
 
+def _attach_elastic(record: dict) -> None:
+    """Fold the elasticity-cost sweep (ISSUE 8) into the record under
+    ``detail.telemetry.elastic``: rescale latency from checkpoint-commit
+    to the first post-rescale step, split cold vs warm
+    persistent-compile-cache — run on the 8-device virtual CPU mesh in
+    a child (with a throwaway ``DCCRG_COMPILE_CACHE_DIR``) so an
+    accelerator outage never blocks the bench line."""
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from benchmarks.microbench import elastic_summary; "
+        "print(json.dumps(elastic_summary(length=6)))"
+        % str(ROOT)
+    )
+    with tempfile.TemporaryDirectory() as td:
+        env["DCCRG_COMPILE_CACHE_DIR"] = td
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], env=env,
+                capture_output=True, text=True, timeout=600,
+            )
+            if r.returncode != 0:
+                print(f"elastic probe failed: {r.stderr[-300:]}",
+                      file=sys.stderr)
+                return
+            line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+            record.setdefault("detail", {}).setdefault(
+                "telemetry", {})["elastic"] = json.loads(line)
+        except Exception as e:  # noqa: BLE001 - never kills the bench
+            print(f"elastic probe failed: {e}", file=sys.stderr)
+
+
 def _attach_telemetry(record: dict) -> None:
     """Fold telemetry.json's phase breakdown into the bench record so
     BENCH_*.json rounds carry where epoch/halo/LB/AMR/checkpoint time
@@ -1244,8 +1284,9 @@ def _attach_telemetry(record: dict) -> None:
                 "kernel_time_us": counters.get(
                     "device.kernel_time_us", {}),
                 "merged_trace": (
-                    "telemetry.json.merged_trace.json"
-                    if (ROOT / "telemetry.json.merged_trace.json").exists()
+                    "tools/telemetry.json.merged_trace.json"
+                    if (ROOT / "tools"
+                        / "telemetry.json.merged_trace.json").exists()
                     else None
                 ),
             },
@@ -1277,6 +1318,7 @@ def _emit(record: dict):
     _attach_telemetry(record)
     _attach_epoch_churn(record)
     _attach_halo_overlap(record)
+    _attach_elastic(record)
     try:
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
     except OSError as e:

@@ -477,6 +477,122 @@ def bench_churn_compile(length: int = 12, cycles: int = 6):
     }))
 
 
+def elastic_summary(length: int = 6, seed: int = 0) -> dict:
+    """The cost of elasticity (ISSUE 8): rescale latency from
+    checkpoint-commit to the first post-rescale step, split cold vs
+    warm persistent-compile-cache, importable so ``bench.py`` folds it
+    into ``detail.telemetry.elastic``.
+
+    Four legs on a refined advection grid: full → half → full are the
+    FIRST landings of a checkpoint-replayed grid at each device count
+    (cold: every landing compiles), then half → full repeats both
+    landings with the persistent compilation cache primed (warm:
+    ``epoch.recompiles`` stays 0, compiles served from disk).  Requires
+    ``DCCRG_COMPILE_CACHE_DIR`` in the environment (the bench child
+    sets a temp dir) for the warm legs to actually warm — without it
+    every leg reports cold and ``cache_enabled`` is False.
+    """
+    import tempfile
+
+    import jax
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import Advection
+    from dccrg_tpu.parallel.exec_cache import persistent_cache_dir
+    from dccrg_tpu.resilience import rescale
+
+    spec = {k: ((), np.float32)
+            for k in ("density", "vx", "vy", "vz")}
+
+    def build():
+        g = (
+            Grid()
+            .set_initial_length((length, length, length))
+            .set_neighborhood_length(0)
+            .set_periodic(True, True, True)
+            .set_maximum_refinement_level(1)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / length,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        rng = np.random.default_rng(seed)
+        ids = np.sort(g.get_cells())
+        for cid in rng.choice(ids, size=max(1, len(ids) // 6),
+                              replace=False):
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        adv = Advection(g, dtype=np.float32, allow_dense=False)
+        st = adv.initialize_state()
+        ids = np.sort(g.get_cells())
+        st = adv.set_cell_data(st, "density", ids,
+                               rng.uniform(1, 2, len(ids))
+                               .astype(np.float32))
+        st = g.update_copies_of_remote_neighbors(st)
+        return g, adv, st
+
+    def totals():
+        rep = obs.metrics.report()
+        return (sum(rep["counters"].get("epoch.recompiles", {})
+                    .values()),
+                sum(rep["counters"].get("epoch.warm_compiles", {})
+                    .values()))
+
+    def leg(g, st, target, lineage_dir):
+        r0, w0 = totals()
+        res = rescale(g, st, spec, target, directory=lineage_dir,
+                      user_header=b"bench")
+        adv2 = Advection(res.grid, dtype=np.float32, allow_dense=False)
+        st2 = adv2.initialize_state()
+        ids2 = np.sort(res.grid.get_cells())
+        st2 = adv2.set_cell_data(
+            st2, "density", ids2,
+            np.asarray(res.grid.get_cell_data(res.state, "density",
+                                              ids2)))
+        st2 = res.grid.update_copies_of_remote_neighbors(st2)
+        dt = np.float32(0.25 * adv2.max_time_step(st2))
+        t0 = time.perf_counter()
+        out = adv2.step(st2, dt)
+        jax.block_until_ready(out["density"])
+        first_step = time.perf_counter() - t0
+        r1, w1 = totals()
+        return res.grid, st2, {
+            "direction": res.direction,
+            "n_devices": res.n_devices_after,
+            "commit_s": round(res.commit_s, 4),
+            "reland_s": round(res.reland_s, 4),
+            "first_step_s": round(first_step, 4),
+            "commit_to_first_step_s": round(
+                res.commit_s + res.reland_s + first_step, 4),
+            "recompiles": int(r1 - r0),
+            "warm_compiles": int(w1 - w0),
+        }
+
+    g, adv, st = build()
+    dt = np.float32(0.25 * adv.max_time_step(st))
+    st = adv.step(st, dt)
+    jax.block_until_ready(st["density"])
+    full = g.n_devices
+    half = max(1, full // 2)
+    with tempfile.TemporaryDirectory() as td:
+        g, st, cold_down = leg(g, st, half, td)   # first landing at half
+        g, st, cold_up = leg(g, st, full, td)     # first replayed landing
+        g, st, warm_down = leg(g, st, half, td)   # cache primed from here
+        g, st, warm_up = leg(g, st, full, td)
+    return {
+        "length": length,
+        "full_devices": full,
+        "half_devices": half,
+        "cache_enabled": persistent_cache_dir() is not None,
+        "cold_down": cold_down,
+        "cold_up": cold_up,
+        "warm_down": warm_down,
+        "warm_up": warm_up,
+    }
+
+
 def halo_overlap_summary(steps: int = 20, length: int = 8, reps: int = 3,
                          seed: int = 0, profile: bool = True) -> dict:
     """Eager vs host-split vs fused split-phase stepping per model

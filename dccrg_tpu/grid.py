@@ -276,11 +276,13 @@ class Grid:
 
     def shape_signature(self):
         """The current epoch's :class:`~dccrg_tpu.parallel.shapes.
-        ShapeSignature` — the identity compiled schedules are keyed by.
-        Two epochs with equal signatures share every cached executable
-        (``grid.exec_cache``); a rebuild that keeps the signature costs
-        zero retraces."""
-        return signature_of(self.epoch)
+        ShapeSignature` — the identity compiled schedules are keyed by,
+        including this grid's held halo ring-size hints (so the
+        signature alone predicts executable-cache behavior across a
+        rescale or warm restart).  Two epochs with equal signatures
+        share every cached executable (``grid.exec_cache``); a rebuild
+        that keeps the signature costs zero retraces."""
+        return signature_of(self.epoch, self._ring_hints)
 
     def _harvest_tables(self, old_epoch) -> None:
         """Park a retired epoch's gather-table buffers for reuse by the

@@ -25,6 +25,23 @@ when jax traces), counting ``epoch.recompiles{kernel=...}`` and a
 process-wide per-label trace count (:func:`trace_counts` — what the
 shape-stability tests assert on).  Dispatches that triggered a trace are
 timed into the ``compile`` phase; warm dispatches cost one counter read.
+
+Zero-cold-start warm restart: the LRU above dies with the process, so a
+restarted or rescaled worker used to pay the full compile storm on its
+first churn cycle even when its :class:`~dccrg_tpu.parallel.shapes.
+ShapeSignature` had been seen before.  :func:`enable_persistent_cache`
+wires jax's persistent compilation cache (``jax_compilation_cache_dir``,
+via ``DCCRG_COMPILE_CACHE_DIR`` — auto-enabled at import so child
+processes inherit it purely through the environment, the same discipline
+as ``DCCRG_FAULT``) under the bucketed-shape discipline: fresh processes
+still *trace* (host work), but XLA compiles are served from disk.  A
+jax monitoring listener counts the cache's own hit/miss events
+(``epoch.persistent_cache{result=hit|miss}``), and a trace whose compile
+was served from the persistent cache is counted as
+``epoch.warm_compiles{kernel}`` instead of ``epoch.recompiles{kernel}``
+— so ``epoch.recompiles == 0`` on a warm restart is a *measured* fact
+(the soak's fork-a-fresh-process proof asserts exactly that), while a
+cold process keeps counting real compiles as before.
 """
 from __future__ import annotations
 
@@ -44,6 +61,9 @@ __all__ = [
     "reset_trace_counts",
     "kernel_labels",
     "mesh_key",
+    "enable_persistent_cache",
+    "persistent_cache_dir",
+    "persistent_cache_counts",
 ]
 
 
@@ -63,10 +83,12 @@ _TRACE_COUNTS: dict = {}
 
 def note_trace(label: str) -> None:
     """Record one trace of the kernel ``label`` — called from inside a
-    jitted body, so it fires exactly when jax (re)traces."""
+    jitted body, so it fires exactly when jax (re)traces.  The
+    ``epoch.recompiles`` / ``epoch.warm_compiles`` split is attributed
+    by the dispatching :class:`TracedKernel`, which can see whether the
+    persistent compilation cache served the compile."""
     with _trace_lock:
         _TRACE_COUNTS[label] = _TRACE_COUNTS.get(label, 0) + 1
-    _metrics.inc("epoch.recompiles", kernel=label)
 
 
 def trace_counts() -> dict:
@@ -86,6 +108,76 @@ def _count(label: str) -> int:
         return _TRACE_COUNTS.get(label, 0)
 
 
+#: persistent compilation cache state: the wired directory, the
+#: hit/miss totals fed by jax's monitoring events, and whether the
+#: listener is installed (once per process)
+_PERSISTENT = {"dir": None, "hits": 0, "misses": 0, "listener": False}
+
+
+def persistent_cache_dir() -> str | None:
+    """The wired ``jax_compilation_cache_dir``, or None when the
+    persistent cache is not enabled in this process."""
+    return _PERSISTENT["dir"]
+
+
+def persistent_cache_counts() -> dict:
+    """Process totals of jax's persistent-compilation-cache events:
+    ``{"hits": n, "misses": n}`` (both 0 until the listener sees one)."""
+    with _trace_lock:
+        return {"hits": _PERSISTENT["hits"],
+                "misses": _PERSISTENT["misses"]}
+
+
+def _on_cache_event(name: str, **kw) -> None:
+    # jax._src.monitoring events; the cache records one hit or miss per
+    # compiled module, which is exactly the granularity TracedKernel
+    # dispatches at (one traced_jit label = one module)
+    if name.endswith("/cache_hits"):
+        with _trace_lock:
+            _PERSISTENT["hits"] += 1
+        _metrics.inc("epoch.persistent_cache", result="hit")
+    elif name.endswith("/cache_misses"):
+        with _trace_lock:
+            _PERSISTENT["misses"] += 1
+        _metrics.inc("epoch.persistent_cache", result="miss")
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Wire jax's persistent compilation cache at ``path`` (default:
+    ``DCCRG_COMPILE_CACHE_DIR``; no-op returning None when neither is
+    set).  Thresholds are dropped to zero so every module is cached —
+    the bucketed-shape discipline keeps the entry set small (one per
+    kernel per ShapeSignature), and a restarted/rescaled worker landing
+    on a previously-seen signature compiles nothing.  Called at import,
+    so child processes opt in purely via the environment."""
+    if path is None:
+        path = os.environ.get("DCCRG_COMPILE_CACHE_DIR") or None
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # noqa: BLE001 — knob absent on this jax
+            pass
+    if not _PERSISTENT["listener"]:
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_listener(_on_cache_event)
+            _PERSISTENT["listener"] = True
+        except Exception:  # noqa: BLE001 — no monitoring: cache still
+            pass           # works, only the hit/miss split goes dark
+    _PERSISTENT["dir"] = str(path)
+    return str(path)
+
+
 class TracedKernel:
     """A jitted callable with trace accounting: dispatches that trigger
     a (re)trace are timed into the ``compile`` phase; warm dispatches
@@ -103,10 +195,21 @@ class TracedKernel:
         if not _metrics.enabled:
             return self.fn(*args)
         n0 = _count(self.label)
+        m0 = _PERSISTENT["misses"]
         t0 = time.perf_counter()
         out = self.fn(*args)
         if _count(self.label) != n0:
             _metrics.phase_add("compile", time.perf_counter() - t0)
+            # with the persistent cache wired, every real XLA compile
+            # reports exactly one hit or miss event — so a trace that
+            # caused NO miss paid no compile (served from disk, or an
+            # inline retrace under an outer jit) and counts warm; with
+            # the cache off, every trace is a cold recompile as before
+            if _PERSISTENT["dir"] is not None \
+                    and _PERSISTENT["misses"] == m0:
+                _metrics.inc("epoch.warm_compiles", kernel=self.label)
+            else:
+                _metrics.inc("epoch.recompiles", kernel=self.label)
         return out
 
 
@@ -208,3 +311,10 @@ class ExecutableCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+
+
+# auto-wire the persistent compilation cache from the environment at
+# import (no-op when DCCRG_COMPILE_CACHE_DIR is unset) — child processes
+# receive the warm-restart cache the same way they receive their fault
+# schedule (DCCRG_FAULT): purely via env
+enable_persistent_cache()

@@ -39,6 +39,7 @@ from typing import NamedTuple
 
 __all__ = [
     "ShapeSignature",
+    "ring_signature",
     "signature_of",
     "epoch_shape_hints",
     "buckets_enabled",
@@ -137,15 +138,38 @@ class ShapeSignature(NamedTuple):
     """The compiled-schedule identity of an epoch: every dimension a
     jitted kernel's trace depends on.  Two epochs with equal signatures
     share every compiled executable — only table *contents* differ, and
-    those flow through kernels as runtime arguments."""
+    those flow through kernels as runtime arguments.
+
+    ``rings`` surfaces the held halo ring-size hints (the per-distance
+    bucketed pair counts ``parallel/halo.py`` keeps grid-persistent): the
+    payload/table shapes of every exchange body and fused split-phase
+    kernel ride them, so without this field two grids could share
+    ``(n_devices, R, kmax, dense)`` yet compile different programs.  With
+    it, ``grid.shape_signature()`` alone predicts executable-cache
+    behavior — equal signatures (same mesh) mean a rescaled or restarted
+    worker re-dispatches or cache-hits every compiled executable."""
 
     n_devices: int
     R: int
     kmax: tuple           # sorted ((hood_key, Kmax), ...)
     dense: bool           # dense fast path detected
+    rings: tuple = ()     # sorted ((hood_key, field, k, S_k), ...)
 
 
-def signature_of(epoch) -> ShapeSignature:
+def ring_signature(ring_hints) -> tuple:
+    """Canonical sortable form of the grid-persistent ring-size hints
+    (``{(hood_id, field, k): held S_k}``) for :class:`ShapeSignature`.
+    Empty before the first halo schedule is built."""
+    if not ring_hints:
+        return ()
+    return tuple(sorted(
+        (_hood_key(hid), "" if field is None else str(field),
+         int(k), int(v))
+        for (hid, field, k), v in ring_hints.items()
+    ))
+
+
+def signature_of(epoch, ring_hints=None) -> ShapeSignature:
     return ShapeSignature(
         n_devices=int(epoch.n_devices),
         R=int(epoch.R),
@@ -154,6 +178,7 @@ def signature_of(epoch) -> ShapeSignature:
             for hid, h in epoch.hoods.items()
         )),
         dense=epoch.dense is not None,
+        rings=ring_signature(ring_hints),
     )
 
 

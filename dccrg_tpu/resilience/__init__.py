@@ -28,9 +28,40 @@ backoff plane for controller p2p sockets lives in
 proof harness: a SIGKILLed child must resume from ``latest_valid()``
 and converge to the uninterrupted run's final state across
 device-count changes.
+
+On top of recovery sits the **elastic fleet** (ISSUE 8):
+
+* :mod:`~dccrg_tpu.resilience.elastic` — :func:`rescale` re-lands a
+  live grid on a larger/smaller mesh through a committed lineage
+  generation (verified, counted ``elastic.rescales{direction}``), and
+  :class:`ElasticPolicy` drives it from live HBM/step-latency signals
+  with hysteresis + cooldown so the fleet never flaps;
+* :mod:`~dccrg_tpu.resilience.supervisor` — a heartbeat watchdog
+  tailing the streaming-JSONL telemetry, escalating stalled or dead
+  workers through warn → degraded rescale-down → restart-from-
+  ``latest_valid()`` (new ``device.lost`` / ``step.hang`` fault sites
+  prove every branch);
+* zero-cold-start warm restart — ``parallel/exec_cache.py`` wires
+  jax's persistent compilation cache (``DCCRG_COMPILE_CACHE_DIR``)
+  under the bucketed-shape discipline, so a restarted or rescaled
+  worker landing on a seen ``ShapeSignature`` records
+  ``epoch.recompiles == 0``.  ``tools/soak.py elastic`` is the proof
+  harness for all three.
 """
-from .inject import FaultPlane, plane, fires, maybe_kill, corrupt_array
+from .inject import (
+    FaultPlane, plane, fires, maybe_kill, corrupt_array, maybe_hang,
+)
 from .manager import CheckpointLineage
+from .elastic import (
+    DeviceLostError,
+    ElasticPolicy,
+    RescaleResult,
+    available_devices,
+    rescale,
+    step_latency_signal,
+    utilization_signal,
+)
+from .supervisor import EscalationLadder, HeartbeatMonitor, Supervisor
 
 __all__ = [
     "FaultPlane",
@@ -38,5 +69,16 @@ __all__ = [
     "fires",
     "maybe_kill",
     "corrupt_array",
+    "maybe_hang",
     "CheckpointLineage",
+    "DeviceLostError",
+    "ElasticPolicy",
+    "RescaleResult",
+    "available_devices",
+    "rescale",
+    "step_latency_signal",
+    "utilization_signal",
+    "EscalationLadder",
+    "HeartbeatMonitor",
+    "Supervisor",
 ]

@@ -1,0 +1,298 @@
+"""Elastic fleet: supervised rescale as a first-class mechanism.
+
+The reference dccrg's operational claim is that a restart file written
+on N processes loads on *any* M (Honkonen et al., CPC 2013) — PR 4
+proved that here as a crash-recovery path.  This module promotes it to a
+scaling mechanism:
+
+* :func:`rescale` — commit one checkpoint-lineage generation (crash-safe
+  anchor: a kill mid-rescale leaves a resumable lineage), re-land grid +
+  state on a mesh of ``n_devices`` through the restart-on-any-count
+  loader, re-verify the restored grid (``utils.verify.verify_grid``
+  inside ``latest_valid``), and count
+  ``elastic.rescales{direction=up|down|same}`` under the
+  ``elastic.rescale`` phase.  The relanded grid is a *fresh* build of
+  the same leaf set, so its shapes are the deterministic fresh-build
+  shapes — any process that compiled the same
+  :class:`~dccrg_tpu.parallel.shapes.ShapeSignature` before (including
+  the ring-hint field) has already populated the persistent compilation
+  cache for it (``parallel/exec_cache.py``), making repeat rescales and
+  worker restarts zero-cold-start.
+
+* :class:`ElasticPolicy` — the load-driven half: maps a utilization
+  signal (HBM gauges via :func:`utilization_signal`, step-latency phase
+  means via :func:`step_latency_signal`) to a target device count with
+  **hysteresis** (``patience`` consecutive readings beyond a watermark
+  before acting) and a **cooldown** after every committed rescale, so an
+  oscillating load never flaps the fleet.  Decisions are counted as
+  ``elastic.policy_decisions{direction}``.
+
+Degraded mode (losing devices rather than choosing to shrink) is the
+supervisor's escalation path (``resilience/supervisor.py``, counted
+``elastic.degraded``); the ``device.lost`` injection site
+(:func:`available_devices`, or ``inject.maybe_raise`` at step
+boundaries) exists to prove that branch.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import NamedTuple
+
+from ..obs.registry import metrics
+from . import inject
+from .manager import CheckpointLineage
+
+__all__ = [
+    "DeviceLostError",
+    "RescaleResult",
+    "available_devices",
+    "rescale",
+    "ElasticPolicy",
+    "utilization_signal",
+    "step_latency_signal",
+]
+
+
+class DeviceLostError(RuntimeError):
+    """A device the fleet was counting on is gone (or the ``device.lost``
+    fault site injected exactly that).  Handlers rescale DOWN in degraded
+    mode or restart from ``latest_valid()`` — never continue on a mesh
+    that no longer exists."""
+
+
+def available_devices() -> int:
+    """How many devices this process can currently place shards on.
+    The ``device.lost`` injection site fires here: an armed plane makes
+    discovery itself report the loss, which is how the escalation
+    ladder's degraded branch is driven in tests and soaks."""
+    if inject.fires("device.lost", where="discovery"):
+        raise DeviceLostError("injected fault at site 'device.lost'")
+    import jax
+
+    return len(jax.devices())
+
+
+class RescaleResult(NamedTuple):
+    """What :func:`rescale` hands back: the relanded grid/state pair plus
+    the evidence a harness asserts on."""
+
+    grid: object
+    state: object
+    user_header: bytes
+    generation: int
+    n_devices_before: int
+    n_devices_after: int
+    direction: str        # "up" | "down" | "same"
+    commit_s: float       # checkpoint-lineage commit wall time
+    reland_s: float       # scan + load + verify on the new mesh
+
+
+def rescale(grid, state, spec, n_devices: int, *, lineage=None,
+            directory: str | None = None, keep: int = 3,
+            user_header: bytes = b"", ragged=None, verify: bool = True,
+            mesh=None) -> RescaleResult:
+    """Re-land ``grid`` + ``state`` on a mesh of ``n_devices`` through a
+    committed checkpoint-lineage generation.
+
+    The sequence is commit → scan/load → verify: the commit makes the
+    rescale crash-safe (a SIGKILL at any point leaves a lineage
+    ``latest_valid()`` resumes from, at ANY device count), the load is
+    the restart-on-any-count path (``io/checkpoint.py`` refinement
+    replay + repartition), and ``verify`` re-runs the grid invariant
+    oracle on the result.  Pass an open :class:`CheckpointLineage` as
+    ``lineage`` or a ``directory`` to open one (``keep`` generations).
+
+    Requesting more devices than exist raises :class:`DeviceLostError`
+    (the same error a mid-flight device loss produces), so policy bugs
+    and hardware loss land in one handler.
+    """
+    if lineage is None:
+        if directory is None:
+            raise ValueError("rescale needs a lineage= or directory=")
+        lineage = CheckpointLineage(directory, keep=keep)
+    n_devices = int(n_devices)
+    if n_devices < 1:
+        raise ValueError(f"cannot rescale to {n_devices} devices")
+    with metrics.phase("elastic.rescale"):
+        avail = available_devices()
+        if mesh is None and n_devices > avail:
+            raise DeviceLostError(
+                f"rescale to {n_devices} devices requested but only "
+                f"{avail} are visible"
+            )
+        before = int(grid.n_devices)
+        direction = ("up" if n_devices > before
+                     else "down" if n_devices < before else "same")
+        t0 = time.perf_counter()
+        gen = lineage.commit(grid, state, spec,
+                             user_header=user_header, ragged=ragged)
+        t1 = time.perf_counter()
+        new_grid, new_state, hdr, rgen = lineage.latest_valid(
+            spec, mesh=mesh, n_devices=n_devices, ragged=ragged,
+            load_balancing_method=grid.get_load_balancing_method(),
+            verify=verify,
+        )
+        t2 = time.perf_counter()
+        metrics.inc("elastic.rescales", direction=direction)
+        metrics.gauge("elastic.n_devices", int(new_grid.n_devices))
+        # refresh the per-device memory gauges on the new mesh — the
+        # policy loop reads them, and the old mesh's series would
+        # otherwise report devices the fleet no longer uses
+        from ..obs.hbm import sample_hbm
+
+        sample_hbm()
+    return RescaleResult(
+        grid=new_grid, state=new_state, user_header=hdr, generation=rgen,
+        n_devices_before=before, n_devices_after=int(new_grid.n_devices),
+        direction=direction, commit_s=t1 - t0, reland_s=t2 - t1,
+    )
+
+
+# --------------------------------------------------------------- signals
+
+
+def utilization_signal(registry=None) -> float | None:
+    """Worst-device HBM utilization in [0, 1] from the ``hbm.*`` gauges
+    (``obs/hbm.py``), or None on backends without allocator stats (the
+    CPU mesh) — the policy then runs on latency alone."""
+    reg = registry if registry is not None else metrics
+    rep = reg.report()
+    used = rep["gauges"].get("hbm.bytes_in_use", {})
+    limit = rep["gauges"].get("hbm.bytes_limit", {})
+    fracs = [used[d] / limit[d] for d in used
+             if limit.get(d) and limit[d] > 0]
+    return max(fracs) if fracs else None
+
+
+def step_latency_signal(target_s: float, phase: str = "halo.exchange",
+                        registry=None) -> float | None:
+    """The ``phase`` mean latency as a fraction of ``target_s`` (1.0 =
+    exactly on target, >1 over budget) — None until the phase has
+    recorded.  Phase means are cumulative, so drive this from a registry
+    the workload resets per policy window, or treat it as a slow EMA."""
+    reg = registry if registry is not None else metrics
+    rep = reg.report()
+    rec = rep["phases"].get(phase)
+    if not rec or target_s <= 0:
+        return None
+    return rec["mean_s"] / float(target_s)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ElasticPolicy:
+    """Hysteresis + cooldown rescale policy.
+
+    Feed it one scalar **load** per control tick (utilization fraction,
+    latency ratio, or the max of both — anything where >``high`` means
+    "too hot" and <``low`` means "wasteful").  :meth:`observe` returns a
+    target device count when a rescale is warranted, else None; after
+    actually performing the rescale the caller reports it with
+    :meth:`committed`, which starts the cooldown.
+
+    Flap-proofing, in order:
+
+    * **watermark gap** — ``low < high``, so one load level can never
+      satisfy both directions;
+    * **patience** — a watermark must be breached on ``patience``
+      *consecutive* ticks before a decision; an oscillating load resets
+      the streak every flip and never acts;
+    * **cooldown** — after a committed rescale, no decision for
+      ``cooldown_s`` seconds, bounding the worst-case rescale rate even
+      under adversarial load.
+
+    Env defaults: ``DCCRG_ELASTIC_HIGH`` (0.85), ``DCCRG_ELASTIC_LOW``
+    (0.35), ``DCCRG_ELASTIC_PATIENCE`` (3), ``DCCRG_ELASTIC_COOLDOWN``
+    (30 s).  Grow doubles, shrink halves (the restart-on-any-count
+    loader accepts anything, but halving keeps shard-count churn — and
+    with it fresh ShapeSignatures — geometric).
+    """
+
+    def __init__(self, n_devices: int, *, min_devices: int = 1,
+                 max_devices: int | None = None, high: float | None = None,
+                 low: float | None = None, patience: int | None = None,
+                 cooldown_s: float | None = None):
+        self.n_devices = int(n_devices)
+        self.min_devices = max(int(min_devices), 1)
+        self.max_devices = (int(max_devices) if max_devices is not None
+                            else None)
+        self.high = (_env_float("DCCRG_ELASTIC_HIGH", 0.85)
+                     if high is None else float(high))
+        self.low = (_env_float("DCCRG_ELASTIC_LOW", 0.35)
+                    if low is None else float(low))
+        if not self.low < self.high:
+            raise ValueError(
+                f"watermarks must satisfy low < high, got "
+                f"low={self.low} high={self.high}"
+            )
+        self.patience = max(
+            _env_int("DCCRG_ELASTIC_PATIENCE", 3)
+            if patience is None else int(patience), 1)
+        self.cooldown_s = (
+            _env_float("DCCRG_ELASTIC_COOLDOWN", 30.0)
+            if cooldown_s is None else float(cooldown_s))
+        self._streak_high = 0
+        self._streak_low = 0
+        self._cooldown_until = float("-inf")
+
+    def _max(self) -> int:
+        if self.max_devices is not None:
+            return self.max_devices
+        try:
+            return available_devices()
+        except DeviceLostError:
+            raise
+        except Exception:  # noqa: BLE001 — no backend: stay put
+            return self.n_devices
+
+    def observe(self, load: float | None, now: float | None = None
+                ) -> int | None:
+        """One control tick: returns the target device count to rescale
+        to, or None.  ``now`` is injectable for deterministic tests
+        (defaults to ``time.monotonic()``)."""
+        if load is None:
+            return None
+        now = time.monotonic() if now is None else float(now)
+        load = float(load)
+        if load > self.high:
+            self._streak_high += 1
+            self._streak_low = 0
+        elif load < self.low:
+            self._streak_low += 1
+            self._streak_high = 0
+        else:
+            self._streak_high = self._streak_low = 0
+        if now < self._cooldown_until:
+            return None
+        if self._streak_high >= self.patience:
+            target = min(self.n_devices * 2, self._max())
+            if target > self.n_devices:
+                metrics.inc("elastic.policy_decisions", direction="up")
+                return target
+        if self._streak_low >= self.patience:
+            target = max(self.n_devices // 2, self.min_devices)
+            if target < self.n_devices:
+                metrics.inc("elastic.policy_decisions", direction="down")
+                return target
+        return None
+
+    def committed(self, n_devices: int, now: float | None = None) -> None:
+        """Report a performed rescale: updates the current count, clears
+        the streaks, and starts the cooldown window."""
+        now = time.monotonic() if now is None else float(now)
+        self.n_devices = int(n_devices)
+        self._streak_high = self._streak_low = 0
+        self._cooldown_until = now + self.cooldown_s

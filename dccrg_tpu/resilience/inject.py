@@ -35,6 +35,12 @@ Sites wired into the codebase:
                            before an exchange (``parallel/halo.py``)
 ``sigkill.post_commit``    SIGKILL the process right after a checkpoint
                            lineage commit (``resilience/manager.py``)
+``device.lost``            raise ``DeviceLostError`` at a device-availability
+                           check (``resilience/elastic.py``) or a supervised
+                           step boundary — the degraded-rescale trigger
+``step.hang``              wedge the step loop (:func:`maybe_hang`) so the
+                           supervisor's heartbeat watchdog sees a stall
+                           (``resilience/supervisor.py``, ``tools/soak.py``)
 =========================  ====================================================
 
 Every trigger is counted in the obs registry as
@@ -48,7 +54,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["FaultPlane", "plane", "fires", "maybe_kill", "corrupt_array"]
+__all__ = ["FaultPlane", "plane", "fires", "maybe_kill", "corrupt_array",
+           "maybe_raise", "maybe_hang", "torn_fraction"]
 
 
 class _Site:
@@ -206,6 +213,23 @@ def torn_fraction(site: str = "checkpoint.torn_write") -> float | None:
 def maybe_raise(site: str, exc: type = ConnectionResetError,
                 **labels) -> None:
     """Raise ``exc`` if ``site`` fires — socket-failure injection for
-    the p2p transport seams."""
+    the p2p transport seams (and, with
+    :class:`~dccrg_tpu.resilience.elastic.DeviceLostError`, the
+    ``device.lost`` site at supervised step boundaries)."""
     if plane.fires(site, **labels):
         raise exc(f"injected fault at site {site!r}")
+
+
+def maybe_hang(site: str = "step.hang", seconds: float = 3600.0,
+               **labels) -> bool:
+    """Sleep ``seconds`` if ``site`` fires — the wedged-step injection:
+    the process stays alive but stops making progress, which is exactly
+    the failure only a heartbeat watchdog (``resilience/supervisor.py``)
+    can detect.  Returns whether the hang fired (the supervisor normally
+    kills the process long before the sleep returns)."""
+    if plane.fires(site, **labels):
+        import time
+
+        time.sleep(float(seconds))
+        return True
+    return False

@@ -39,6 +39,17 @@ Checks (exit 1 on any failure):
   profiled round additionally drives the fused split-phase advection and
   vlasov steps and requires their per-model
   ``overlap.fraction{model=..., phase=halo}`` gauges;
+* an elastic round (ISSUE 8): one forced rescale down AND up through a
+  checkpoint lineage (payload bit-identical both ways, the
+  ``elastic.rescale`` phase + ``elastic.rescales{direction}`` counters
+  required) plus a driven watchdog escalation over a synthetic stalled
+  heartbeat (warn → rescale-down → restart in order, leaving
+  ``supervisor.warnings`` / ``supervisor.escalations`` /
+  ``elastic.degraded``);
+* side artifacts (``<out>.stream.jsonl`` / ``.trace.json`` /
+  ``.merged_trace.json``) land next to ``--out`` — or under ``tools/``
+  when ``--out`` is the repo root's ``telemetry.json``, keeping bench
+  byproducts out of the root (``--artifact-dir`` overrides);
 * unless ``--skip-overhead``: enabling telemetry must not slow the
   workload's step loop by more than ``--threshold`` (default 1.05 =
   5%) vs the disabled mode — the zero-cost-when-disabled and
@@ -75,6 +86,9 @@ REQUIRED_PHASES = (
     # ISSUE 5: kernel (re)traces are timed — a probe run always compiles
     # its kernels at least once in a fresh process
     "compile",
+    # ISSUE 8: the forced rescale round must time the full commit ->
+    # re-land -> verify pipeline
+    "elastic.rescale",
 )
 
 #: counters that must be nonzero after the workload
@@ -104,6 +118,14 @@ REQUIRED_NONZERO_COUNTERS = (
     # coverage loss, exactly like an uncounted injected fault
     "halo.backend_schedules",
     "halo.verify_checks",
+    # ISSUE 8: the forced rescale + driven watchdog ladder must leave
+    # the full elastic-fleet evidence — a rescale that is not counted,
+    # or an escalation rung that never fires, is lost coverage of the
+    # supervised-rescale plane
+    "elastic.rescales",
+    "elastic.degraded",
+    "supervisor.warnings",
+    "supervisor.escalations",
 )
 
 
@@ -232,6 +254,22 @@ def validate_chrome_trace(path: str) -> list:
                 f"({[n for n, _ in stack]})"
             )
     return failures
+
+
+def artifact_path(out_path: str, suffix: str,
+                  artifact_dir: str | None = None) -> str:
+    """Where a side artifact (``<out basename><suffix>``) lands.
+
+    Default: next to ``out_path`` — EXCEPT when ``out_path`` sits at the
+    repo root (the bench's ``telemetry.json``), whose byproducts are
+    archived under ``tools/`` alongside ``telemetry_prev.json`` and the
+    history instead of littering the root (ISSUE 8).  An explicit
+    ``artifact_dir`` (``--artifact-dir``) overrides either way."""
+    out = pathlib.Path(out_path)
+    if artifact_dir is None:
+        parent = out.resolve().parent
+        artifact_dir = ROOT / "tools" if parent == ROOT else parent
+    return str(pathlib.Path(artifact_dir) / (out.name + suffix))
 
 
 def _ensure_env() -> None:
@@ -551,7 +589,85 @@ def _halo_backend_probe() -> list:
     return failures
 
 
-def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
+def _elastic_probe(g, state) -> list:
+    """Forced rescale round + driven watchdog ladder (ISSUE 8).
+
+    Rescale the probe grid down to half its devices and back up through
+    a checkpoint lineage (``resilience/elastic.py``) — the payload must
+    survive both re-landings bit-identically and both directions must be
+    counted under the ``elastic.rescale`` phase.  Then drive the
+    supervisor's escalation ladder over a synthetic stalled heartbeat:
+    warn → rescale-down (``elastic.degraded``) → restart must fire in
+    exactly that order.  Returns failure strings."""
+    import numpy as np
+
+    from dccrg_tpu import obs
+    from dccrg_tpu.resilience import (
+        EscalationLadder,
+        HeartbeatMonitor,
+        Supervisor,
+        rescale,
+    )
+
+    failures: list = []
+    spec = {"density": ((), np.float32)}
+    ids = g.get_cells()
+    want = np.asarray(g.get_cell_data(state, "density", ids))
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            down = max(1, g.n_devices // 2)
+            r = rescale(g, state, spec, down,
+                        directory=os.path.join(td, "lineage"),
+                        user_header=b"elastic-probe")
+            r2 = rescale(r.grid, r.state, spec, g.n_devices,
+                         directory=os.path.join(td, "lineage"),
+                         user_header=b"elastic-probe")
+            for tag, res, nd in (("down", r, down),
+                                 ("up", r2, g.n_devices)):
+                if res.n_devices_after != nd:
+                    failures.append(
+                        f"elastic probe: rescale {tag} landed on "
+                        f"{res.n_devices_after} devices, wanted {nd}"
+                    )
+                got = np.asarray(
+                    res.grid.get_cell_data(res.state, "density", ids)
+                )
+                if not np.array_equal(got, want):
+                    failures.append(
+                        f"elastic probe: rescale {tag} altered the "
+                        "payload"
+                    )
+        except Exception as e:  # noqa: BLE001 — probe reports, not dies
+            failures.append(f"elastic rescale probe failed: {e!r}")
+
+    # watchdog ladder over a synthetic stalled heartbeat (injected
+    # clock, so the probe never sleeps)
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            hb = os.path.join(td, "hb.jsonl")
+            s = obs.TelemetryStream(hb, period=3600.0, truncate=True)
+            s.write_snapshot(step=0)
+            mon = HeartbeatMonitor(hb, stall_after_s=1.0, now=0.0)
+            sup = Supervisor(mon, ladder=EscalationLadder())
+            first = sup.poll(now=0.5)
+            if first["status"] != "ok":
+                failures.append(
+                    f"elastic probe: fresh heartbeat read as "
+                    f"{first['status']}"
+                )
+            acts = [sup.poll(now=10.0 + i)["action"] for i in range(3)]
+            if acts != ["warn", "rescale_down", "restart"]:
+                failures.append(
+                    f"elastic probe: escalation ladder ran {acts}, "
+                    "wanted ['warn', 'rescale_down', 'restart']"
+                )
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"elastic watchdog probe failed: {e!r}")
+    return failures
+
+
+def _device_timeline_probe(g, adv, state, dt, out_path: str,
+                           merged_path: str | None = None) -> list:
     """Profiled round (ISSUE 6): capture one split-phase drive under
     ``jax.profiler``, merge the xplane capture with the host timeline,
     and require the measured plane to materialize — a schema-valid
@@ -570,7 +686,8 @@ def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
         print("device-timeline probe skipped (DCCRG_XPLANE=0)",
               file=sys.stderr)
         return failures
-    merged_path = str(out_path) + ".merged_trace.json"
+    if merged_path is None:
+        merged_path = artifact_path(out_path, ".merged_trace.json")
     with tempfile.TemporaryDirectory() as td:
         try:
             with obs.profile_trace(td):
@@ -652,9 +769,11 @@ def _device_timeline_probe(g, adv, state, dt, out_path: str) -> list:
 
 
 def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
-              reps: int = 11, threshold: float = 1.05) -> list:
+              reps: int = 11, threshold: float = 1.05,
+              artifact_dir: str | None = None) -> list:
     """Run the workload + checks; returns a list of failure strings
-    (empty = pass) and writes ``telemetry.json`` to ``out_path``."""
+    (empty = pass) and writes ``telemetry.json`` to ``out_path`` (side
+    artifacts — stream/trace/merged-trace — via :func:`artifact_path`)."""
     _ensure_env()
     import numpy as np
 
@@ -694,7 +813,12 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
         # land inside the timed reps and flake the 5% budget
         failures += _overhead_probe(g, adv, state, dt, steps,
                                     reps=reps, threshold=threshold)
-    failures += _device_timeline_probe(g, adv, state, dt, out_path)
+    failures += _elastic_probe(g, state)
+    failures += _device_timeline_probe(
+        g, adv, state, dt, out_path,
+        merged_path=artifact_path(out_path, ".merged_trace.json",
+                                  artifact_dir),
+    )
 
     report = g.report()
     for phase in REQUIRED_PHASES:
@@ -723,7 +847,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     # streaming exporter: a few explicit snapshots (no timer sleeps —
     # the probe must stay fast/deterministic) driven through real work
     # between ticks, then schema-validated like any soak/bench stream
-    stream_path = str(out_path) + ".stream.jsonl"
+    stream_path = artifact_path(out_path, ".stream.jsonl", artifact_dir)
     s = obs.TelemetryStream(stream_path, period=3600.0, truncate=True,
                             extra={"workload": "check_telemetry probe"})
     s.write_snapshot(checkpoint="pre")
@@ -734,7 +858,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
 
     # event timeline: the probe's spans as a Chrome trace, validated for
     # matched begin/end pairs and monotonic in-thread timestamps
-    trace_path = str(out_path) + ".trace.json"
+    trace_path = artifact_path(out_path, ".trace.json", artifact_dir)
     if not obs.timeline.enabled or len(obs.timeline) == 0:
         failures.append("event timeline recorded no spans during probe")
     obs.export_chrome_trace(trace_path)
@@ -792,6 +916,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=str(ROOT / "telemetry.json"),
                     help="where to write telemetry.json")
+    ap.add_argument("--artifact-dir", default=None,
+                    help="where the stream/trace/merged-trace side "
+                         "artifacts land (default: next to --out, or "
+                         "tools/ when --out is at the repo root — the "
+                         "root stays free of bench byproducts)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--reps", type=int, default=11,
                     help="overhead-probe repetitions per mode (one rep "
@@ -836,7 +965,8 @@ def main(argv=None) -> int:
         return 1 if failures else 0
     failures = run_check(args.out, steps=args.steps,
                          skip_overhead=args.skip_overhead,
-                         reps=args.reps, threshold=args.threshold)
+                         reps=args.reps, threshold=args.threshold,
+                         artifact_dir=args.artifact_dir)
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)

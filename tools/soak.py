@@ -22,6 +22,19 @@ uninterrupted run's final state: GoL exactly, advection within the
 cross-layout tolerance).  Per-seed crash/resume outcomes stream into
 the telemetry JSONL (``obs/stream.py``), so a hung crash-soak leaves
 evidence of which generation each attempt was resuming from.
+
+The ``elastic`` subsystem (ISSUE 8) is the supervised-rescale proof:
+a child runs GoL + advection under AMR churn while performing seeded
+in-process grow/shrink rescales (``resilience/elastic.py``), streaming
+a heartbeat the parent's ``Supervisor`` tails; injected ``step.hang``
+faults wedge the step loop (the watchdog must detect the stall and
+escalate to a degraded rescale-down) and injected ``device.lost``
+faults kill the worker (the supervisor relaunches it at fewer devices
+from ``latest_valid()``).  The completed run must converge to a
+fixed-mesh reference bit-identically (GoL exact, advection 1e-11),
+and a fork-a-fresh-process warm-start proof must then resume from the
+lineage with ``epoch.recompiles == 0`` on the held ShapeSignature
+(the persistent compilation cache, ``DCCRG_COMPILE_CACHE_DIR``).
 """
 import argparse
 import pathlib
@@ -1039,6 +1052,515 @@ def run_crash(lo: int, hi: int, stream_dir: str | None = None,
     return ok_all
 
 
+#: the elastic-subsystem child: GoL + advection-under-AMR-churn with
+#: periodic lineage commits, seeded in-process grow/shrink rescales
+#: (``resilience/elastic.py``), a 0.5 s heartbeat stream the parent's
+#: Supervisor tails, and per-step fault hooks (``step.hang`` wedges the
+#: loop for the watchdog to catch; ``device.lost`` exits 42 for the
+#: supervisor to relaunch degraded).  The churn + rescale schedules are
+#: pure functions of (seed, step), so every attempt — and the fixed-mesh
+#: reference (do_rescale=0, no faults) — walks the same structural
+#: history and must converge to the same final state.
+#: argv: workdir seed n_devices total_steps every do_rescale
+ELASTIC_CHILD = r"""import sys
+wd, seed, nd, total, every, do_rescale = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:   # old jax: pre-init XLA_FLAGS is the only knob
+    import os as _os
+    if 'xla_force_host_platform_device_count' not in _os.environ.get('XLA_FLAGS', ''):
+        _os.environ['XLA_FLAGS'] = (_os.environ.get('XLA_FLAGS', '')
+            + ' --xla_force_host_platform_device_count=8').strip()
+jax.config.update('jax_enable_x64', True)
+import os
+import numpy as np
+sys.path.insert(0, __DCCRG_ROOT__)
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.io.checkpoint import CheckpointError
+from dccrg_tpu.models import Advection, GameOfLife
+from dccrg_tpu.resilience import (CheckpointLineage, DeviceLostError,
+                                  rescale)
+from dccrg_tpu.resilience import inject
+
+hb = os.environ.get('DCCRG_ELASTIC_HEARTBEAT',
+                    os.path.join(wd, 'heartbeat.jsonl'))
+stream = obs.stream_to(hb, period=0.5,
+                       extra={'subsystem': 'elastic', 'seed': seed})
+
+ADV_SPEC = {k: ((), np.float64) for k in ('density', 'vx', 'vy', 'vz')}
+
+
+def atomic_save(path, arr):
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def schedules(phase):
+    '''Seeded (rescale, churn) schedules — pure in (seed, phase), so
+    every launch of every attempt agrees on the structural history.'''
+    rng = np.random.default_rng(100_000 + seed * 7 + phase)
+    n_r = min(3, max(1, total // 6))
+    steps = np.sort(rng.choice(np.arange(2, total), size=n_r,
+                               replace=False))
+    rescales = {int(s): int(rng.choice([1, 2, 4, 8]))
+                for s in steps}
+    churn = {int(s) for s in rng.choice(np.arange(1, total),
+                                        size=min(3, total // 5),
+                                        replace=False)}
+    return rescales, churn
+
+
+def step_hooks(phase, step):
+    '''Per-step fault seams: a hang wedges the loop (the supervisor's
+    heartbeat watchdog must catch it); a device loss aborts to exit 42
+    (the supervisor must relaunch degraded).'''
+    stream.write_snapshot(phase=phase, step=step)
+    inject.maybe_raise('device.lost', DeviceLostError, where='step')
+    inject.maybe_hang('step.hang', seconds=600.0)
+
+
+def churn_refine(g, s, rng_tag):
+    '''Deterministic one-cell refinement churn: the target is chosen
+    from the SORTED leaf ids, so every layout/device-count agrees.'''
+    ids = np.sort(g.get_cells())
+    lvl = g.mapping.get_refinement_level(ids)
+    cand = ids[lvl < g.mapping.max_refinement_level]
+    if not len(cand):
+        return g, s, False
+    g.refine_completely(int(cand[rng_tag % len(cand)]))
+    g.stop_refining()
+    s = g.remap_state(s)
+    return g, s, True
+
+
+def run_phases():
+    # ---- phase 1: Game of Life (exact across counts and rescales) --------
+    final = os.path.join(wd, 'gol_final.npy')
+    if not os.path.exists(final):
+        rescales, _churn = schedules(0)
+        rng = np.random.default_rng(seed)
+        g = (Grid().set_initial_length((10, 10, 1)).set_neighborhood_length(1)
+             .set_periodic(True, True, False)
+             .initialize(mesh=make_mesh(n_devices=nd)))
+        cells = g.get_cells()
+        alive0 = cells[rng.random(len(cells)) < 0.35]
+        lineage = CheckpointLineage(os.path.join(wd, 'gol'), keep=3)
+        try:
+            g, s, hdr, gen = lineage.latest_valid(GameOfLife.SPEC,
+                                                  n_devices=nd)
+            step = int(hdr)
+            gol = GameOfLife(g)
+            print('RESUMED gol gen=%d step=%d nd=%d' % (gen, step, nd),
+                  flush=True)
+        except CheckpointError:
+            gol = GameOfLife(g)
+            s = gol.new_state(alive_cells=alive0)
+            step = 0
+            print('FRESH gol nd=%d' % nd, flush=True)
+        while step < total:
+            step_hooks('gol', step)
+            if do_rescale and step in rescales and rescales[step] != g.n_devices:
+                r = rescale(g, s, GameOfLife.SPEC, rescales[step],
+                            lineage=lineage, user_header=str(step).encode())
+                g, s = r.grid, r.state
+                gol = GameOfLife(g)
+                print('RESCALED gol step=%d %d->%d' % (
+                    step, r.n_devices_before, r.n_devices_after), flush=True)
+            s = gol.run(s, 1)
+            step += 1
+            if step % every == 0:
+                lineage.commit(g, s, GameOfLife.SPEC,
+                               user_header=str(step).encode())
+        atomic_save(final, np.sort(gol.alive_cells(s)))
+
+    # ---- phase 2: advection under AMR churn (1e-11 across layouts) -------
+    final = os.path.join(wd, 'adv_final.npy')
+    if not os.path.exists(final):
+        rescales, churn = schedules(1)
+        rng = np.random.default_rng(seed + 1)
+        n = 4
+        g = (Grid().set_initial_length((n, n, n)).set_neighborhood_length(0)
+             .set_periodic(True, True, True).set_maximum_refinement_level(1)
+             .set_geometry(CartesianGeometry, start=(0., 0., 0.),
+                           level_0_cell_length=(1. / n,) * 3)
+             .initialize(mesh=make_mesh(n_devices=nd)))
+        ids0 = np.sort(g.get_cells())
+        for cid in rng.choice(ids0, size=max(1, len(ids0) // 5),
+                              replace=False):
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        ids = np.sort(g.get_cells())
+        dens0 = rng.uniform(1, 2, len(ids))
+        vels0 = {f: rng.uniform(-0.2, 0.2, len(ids))
+                 for f in ('vx', 'vy', 'vz')}
+
+
+        def land(g2, s2):
+            '''(re)build the model + full state from a loaded/rescaled
+            (grid, spec-field state) pair — the shared landing path for
+            fresh starts, resumes, rescales, and churn rebuilds.'''
+            ids2 = np.sort(g2.get_cells())
+            a2 = Advection(g2)
+            st = a2.initialize_state()
+            for f in ADV_SPEC:
+                st = a2.set_cell_data(st, f, ids2,
+                                      g2.get_cell_data(s2, f, ids2))
+            st = g2.update_copies_of_remote_neighbors(st)
+            return a2, st
+
+
+        adv = Advection(g)
+        s0 = adv.initialize_state()
+        s0 = adv.set_cell_data(s0, 'density', ids, dens0)
+        for f in ('vx', 'vy', 'vz'):
+            s0 = adv.set_cell_data(s0, f, ids, vels0[f])
+        s0 = g.update_copies_of_remote_neighbors(s0)
+        dt = 0.3 * adv.max_time_step(s0)
+        lineage = CheckpointLineage(os.path.join(wd, 'adv'), keep=3)
+        try:
+            g2, s2, hdr, gen = lineage.latest_valid(ADV_SPEC, n_devices=nd)
+            step = int(hdr)
+            g = g2
+            adv, s = land(g, s2)
+            print('RESUMED adv gen=%d step=%d nd=%d' % (gen, step, nd),
+                  flush=True)
+        except CheckpointError:
+            s = s0
+            step = 0
+            print('FRESH adv nd=%d' % nd, flush=True)
+        while step < total:
+            step_hooks('adv', step)
+            if step in churn:
+                g, s, did = churn_refine(g, s, 7919 * (step + 1))
+                if did:
+                    s = g.update_copies_of_remote_neighbors(s)
+                    adv = Advection(g)
+            if do_rescale and step in rescales and rescales[step] != g.n_devices:
+                r = rescale(g, s, ADV_SPEC, rescales[step], lineage=lineage,
+                            user_header=str(step).encode())
+                g = r.grid
+                adv, s = land(g, r.state)
+                print('RESCALED adv step=%d %d->%d' % (
+                    step, r.n_devices_before, r.n_devices_after), flush=True)
+            s = adv.step(s, dt)
+            step += 1
+            if step % every == 0:
+                lineage.commit(g, s, ADV_SPEC, user_header=str(step).encode())
+        ids_f = np.sort(g.get_cells())
+        atomic_save(final, np.asarray(
+            g.get_cell_data(s, 'density', ids_f), np.float64))
+
+
+
+try:
+    run_phases()
+except DeviceLostError as e:
+    print('DEVICE_LOST:', e, flush=True)
+    sys.exit(42)
+print('ELASTIC_CHILD_DONE', flush=True)
+"""
+
+#: the zero-cold-start proof child: resume the elastic run's advection
+#: lineage on ``nd`` devices, run one deterministic churn cycle, and
+#: report the grid's ShapeSignature + the recompile/warm-compile split.
+#: Run twice with DCCRG_COMPILE_CACHE_DIR shared: the first populates
+#: the persistent compilation cache for the signature, the second — a
+#: genuinely fresh process — must record ``epoch.recompiles == 0`` on
+#: the SAME signature (every compile served from disk).
+#: argv: workdir n_devices out_json
+PROOF_CHILD = r"""import sys, json
+wd, nd, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+import jax
+jax.config.update('jax_platforms', 'cpu')
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    import os as _os
+    if 'xla_force_host_platform_device_count' not in _os.environ.get('XLA_FLAGS', ''):
+        _os.environ['XLA_FLAGS'] = (_os.environ.get('XLA_FLAGS', '')
+            + ' --xla_force_host_platform_device_count=8').strip()
+jax.config.update('jax_enable_x64', True)
+import os
+import numpy as np
+sys.path.insert(0, __DCCRG_ROOT__)
+from dccrg_tpu import Grid, make_mesh, obs
+from dccrg_tpu.models import Advection
+from dccrg_tpu.parallel.exec_cache import persistent_cache_counts
+from dccrg_tpu.resilience import CheckpointLineage
+
+ADV_SPEC = {k: ((), np.float64) for k in ('density', 'vx', 'vy', 'vz')}
+lineage = CheckpointLineage(os.path.join(wd, 'adv'), keep=3)
+g, s2, hdr, gen = lineage.latest_valid(ADV_SPEC, n_devices=nd)
+ids = np.sort(g.get_cells())
+adv = Advection(g)
+s = adv.initialize_state()
+for f in ADV_SPEC:
+    s = adv.set_cell_data(s, f, ids, g.get_cell_data(s2, f, ids))
+s = g.update_copies_of_remote_neighbors(s)
+dt = 0.25 * adv.max_time_step(s)
+s = adv.step(s, dt)
+# one churn cycle (deterministic target): rebuild + re-land + step —
+# the "first churn cycle already warm" claim under proof
+lvl = g.mapping.get_refinement_level(ids)
+cand = ids[lvl < g.mapping.max_refinement_level]
+if len(cand):
+    g.refine_completely(int(cand[len(cand) // 2]))
+    g.stop_refining()
+    s = g.remap_state(s)
+    s = g.update_copies_of_remote_neighbors(s)
+    adv = Advection(g)
+    s = adv.step(s, dt)
+jax.block_until_ready(s['density'])
+rep = obs.metrics.report()
+rec = {
+    'signature': repr(g.shape_signature()),
+    'generation': gen,
+    'recompiles': int(sum(
+        rep['counters'].get('epoch.recompiles', {}).values())),
+    'warm_compiles': int(sum(
+        rep['counters'].get('epoch.warm_compiles', {}).values())),
+    'persistent_cache': persistent_cache_counts(),
+}
+with open(out, 'w') as f:
+    json.dump(rec, f)
+print('PROOF_CHILD_DONE', json.dumps(rec), flush=True)
+"""
+
+
+def run_elastic(lo: int, hi: int, stream_dir: str | None = None,
+                total_steps: int = 18, every: int = 3) -> bool:
+    """The elastic-fleet proof harness (ISSUE 8).  Per seed:
+
+    1. a fixed-mesh reference child runs the workload to completion
+       (same seeded AMR-churn schedule, no rescales, no faults);
+    2. an elastic run: the child performs seeded in-process grow/shrink
+       rescales while the parent's :class:`Supervisor` tails its 0.5 s
+       heartbeat stream — attempt 0 arms an injected ``step.hang``
+       (the watchdog must detect the stall and escalate warn →
+       rescale-down: the child is killed and relaunched DEGRADED at
+       half the devices), attempt 1 arms ``device.lost`` (the child
+       exits 42; the supervisor's dead-child path relaunches it at
+       fewer devices from ``latest_valid()``), later attempts run
+       clean; every relaunch resumes from the lineage;
+    3. the completed run's final states must match the reference —
+       GoL exactly, advection to the 1e-11 cross-layout tolerance;
+    4. the warm-start proof: two fresh processes resume the final
+       lineage under a shared ``DCCRG_COMPILE_CACHE_DIR`` and run one
+       churn cycle; the second must land on the first's ShapeSignature
+       with ``epoch.recompiles == 0`` (every compile a persistent-cache
+       hit).
+    """
+    import json
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    from dccrg_tpu.obs.stream import TelemetryStream
+    from dccrg_tpu.resilience import HeartbeatMonitor, Supervisor
+
+    stream = None
+    if stream_dir:
+        os.makedirs(stream_dir, exist_ok=True)
+        stream = TelemetryStream(
+            os.path.join(stream_dir, f"elastic_{lo}_{hi}.jsonl"),
+            truncate=True,
+            extra={"subsystem": "elastic", "seeds": [lo, hi]},
+        )
+
+    def record(**kw):
+        if stream is not None:
+            stream.write_snapshot(**kw)
+
+    def launch(body, argv, env_extra=None, log_name="child.log"):
+        env = dict(os.environ)
+        env.pop("DCCRG_FAULT", None)
+        env.update(env_extra or {})
+        log = open(os.path.join(argv[0], log_name), "a")
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             body.replace("__DCCRG_ROOT__", repr(str(ROOT)))]
+            + [str(a) for a in argv],
+            cwd=str(ROOT), stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        return p, log
+
+    def supervise(p, hb_path, stall_after=25.0, timeout=600.0):
+        """Poll the child's heartbeat until it exits or the watchdog
+        decides; returns ``(outcome, returncode)`` where outcome is
+        ``exited`` | ``rescale_down`` | ``restart`` | ``timeout``."""
+        mon = HeartbeatMonitor(hb_path, stall_after_s=stall_after)
+        sup = Supervisor(mon, child_alive=lambda: p.poll() is None)
+        t0 = time.monotonic()
+        while True:
+            time.sleep(0.3)
+            if p.poll() is not None:
+                if p.returncode != 0:
+                    # count the dead-child escalation in THIS process's
+                    # registry (the child's own counters died with it)
+                    sup.poll()
+                return "exited", p.returncode
+            act = sup.poll()
+            if act["action"] == "warn":
+                print(f"    watchdog: WARN ({act['reason']})", flush=True)
+            elif act["action"] in ("rescale_down", "restart"):
+                p.kill()
+                p.wait()
+                return act["action"], None
+            if time.monotonic() - t0 > timeout:
+                p.kill()
+                p.wait()
+                return "timeout", None
+
+    nd_ref = 2
+    max_attempts = 8
+    ok_all = True
+    for seed in range(lo, hi):
+        tmp = tempfile.mkdtemp(prefix=f"dccrg_elastic_{seed}_")
+        cache_dir = os.path.join(tmp, "compile_cache")
+        try:
+            # 1. fixed-mesh reference (no rescales, no faults)
+            ref = os.path.join(tmp, "ref")
+            os.makedirs(ref)
+            p, log = launch(
+                ELASTIC_CHILD,
+                [ref, seed, nd_ref, total_steps, every, 0],
+                {"DCCRG_COMPILE_CACHE_DIR": cache_dir},
+            )
+            rc = p.wait()
+            log.close()
+            if rc != 0:
+                print(f"elastic seed {seed}: reference failed rc={rc}")
+                print(open(os.path.join(ref, "child.log")).read()[-2000:])
+                record(seed=seed, outcome="reference-failed", exit=rc)
+                ok_all = False
+                continue
+
+            # 2. supervised elastic run with injected hang + device loss
+            wd = os.path.join(tmp, "elastic")
+            os.makedirs(wd)
+            nd = 4
+            rc = -1
+            for attempt in range(max_attempts):
+                hb = os.path.join(wd, f"heartbeat_{attempt}.jsonl")
+                env_extra = {
+                    "DCCRG_ELASTIC_HEARTBEAT": hb,
+                    "DCCRG_COMPILE_CACHE_DIR": cache_dir,
+                }
+                fault = "none"
+                if attempt == 0:
+                    # wedge the step loop a few steps in: only the
+                    # heartbeat watchdog can see this failure
+                    fault = "step.hang"
+                    env_extra["DCCRG_FAULT"] = \
+                        f"step.hang:1:{seed}:1:{2 + seed % 3}"
+                elif attempt == 1:
+                    fault = "device.lost"
+                    env_extra["DCCRG_FAULT"] = \
+                        f"device.lost:1:{seed}:1:{3 + seed % 4}"
+                p, log = launch(
+                    ELASTIC_CHILD,
+                    [wd, seed, nd, total_steps, every, 1],
+                    env_extra,
+                )
+                outcome, rc = supervise(p, hb)
+                log.close()
+                record(seed=seed, attempt=attempt, n_devices=nd,
+                       fault=fault, outcome=outcome, exit=rc)
+                print(f"  attempt {attempt} nd={nd} fault={fault}: "
+                      f"{outcome} rc={rc}", flush=True)
+                if outcome == "exited" and rc == 0:
+                    break
+                # degraded relaunch at fewer devices after a watchdog
+                # rescale-down or a device loss (exit 42); a restart
+                # keeps the count
+                if outcome == "rescale_down" or rc == 42:
+                    nd = max(1, nd // 2)
+            if rc != 0:
+                print(f"elastic seed {seed}: no attempt completed "
+                      f"(last rc={rc})")
+                print(open(os.path.join(wd, "child.log")).read()[-2000:])
+                record(seed=seed, outcome="never-completed", exit=rc)
+                ok_all = False
+                continue
+
+            # 3. convergence against the fixed-mesh reference
+            try:
+                gol_ref = np.load(os.path.join(ref, "gol_final.npy"))
+                gol_got = np.load(os.path.join(wd, "gol_final.npy"))
+                np.testing.assert_array_equal(gol_got, gol_ref)
+                adv_ref = np.load(os.path.join(ref, "adv_final.npy"))
+                adv_got = np.load(os.path.join(wd, "adv_final.npy"))
+                np.testing.assert_allclose(adv_got, adv_ref,
+                                           rtol=1e-11, atol=0)
+            except AssertionError as e:
+                print(f"elastic seed {seed}: DIVERGED from fixed-mesh "
+                      f"reference: {str(e)[:300]}")
+                record(seed=seed, outcome="diverged")
+                ok_all = False
+                continue
+
+            # 4. fresh-process warm-start proof on the held signature
+            proofs = []
+            proof_ok = True
+            for i in range(2):
+                out = os.path.join(wd, f"proof_{i}.json")
+                p, log = launch(
+                    PROOF_CHILD, [wd, nd, out],
+                    {"DCCRG_COMPILE_CACHE_DIR": cache_dir},
+                    log_name=f"proof_{i}.log",
+                )
+                prc = p.wait()
+                log.close()
+                if prc != 0:
+                    print(f"elastic seed {seed}: proof child {i} rc={prc}")
+                    print(open(os.path.join(
+                        wd, f"proof_{i}.log")).read()[-1500:])
+                    proof_ok = False
+                    break
+                with open(out) as f:
+                    proofs.append(json.load(f))
+            if proof_ok:
+                a, b = proofs
+                if b["signature"] != a["signature"]:
+                    print(f"elastic seed {seed}: warm-start signature "
+                          f"drifted: {a['signature']} -> {b['signature']}")
+                    proof_ok = False
+                elif b["recompiles"] != 0 or b["warm_compiles"] == 0:
+                    print(f"elastic seed {seed}: warm start NOT warm: "
+                          f"recompiles={b['recompiles']} "
+                          f"warm={b['warm_compiles']} "
+                          f"cache={b['persistent_cache']}")
+                    proof_ok = False
+            record(seed=seed,
+                   outcome="ok" if proof_ok else "warm-start-failed",
+                   attempts=attempt + 1, proofs=proofs)
+            if not proof_ok:
+                ok_all = False
+                continue
+            print(f"elastic seed {seed}: OK after {attempt + 1} "
+                  f"attempt(s); warm start recompiles=0 "
+                  f"(warm_compiles={proofs[1]['warm_compiles']})")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if stream is not None:
+        stream.stop(final=True)
+    print(f"{'elastic':12s} [{lo},{hi}): {'OK' if ok_all else 'FAIL'}")
+    return ok_all
+
+
 #: prepended to every child body when streaming is on: appends an
 #: incremental registry snapshot as JSONL every few seconds (plus a
 #: final one at exit), so a hung or killed seed leaves the phase
@@ -1135,7 +1657,8 @@ def merge_fleet(stream_dir: str) -> str | None:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("subsystem", choices=list(BODIES) + ["crash", "all"])
+    ap.add_argument("subsystem",
+                    choices=list(BODIES) + ["crash", "elastic", "all"])
     ap.add_argument("--seeds", type=int, nargs=2, default=(0, 10))
     ap.add_argument("--crash-seeds", type=int, nargs=2, default=None,
                     help="seed range for the crash subsystem under "
@@ -1153,6 +1676,8 @@ def main():
     results = []
     if a.subsystem == "crash":
         results.append(run_crash(*a.seeds, stream_dir=sdir))
+    elif a.subsystem == "elastic":
+        results.append(run_elastic(*a.seeds, stream_dir=sdir))
     else:
         results += [run(n, *a.seeds, stream_dir=sdir)
                     for n in names if n != "crash"]
@@ -1160,6 +1685,7 @@ def main():
             lo, hi = a.crash_seeds or (a.seeds[0],
                                        min(a.seeds[0] + 3, a.seeds[1]))
             results.append(run_crash(lo, hi, stream_dir=sdir))
+            results.append(run_elastic(lo, hi, stream_dir=sdir))
     if sdir:
         merge_fleet(sdir)
     sys.exit(0 if all(results) else 1)

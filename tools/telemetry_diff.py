@@ -160,6 +160,12 @@ DEFAULT_ALLOW = (
     # cost scales with how many exchanges the round chose to verify,
     # which is workload-shaped, not a perf regression
     "halo.verify",
+    # ISSUE 8 elastic phases: a rescale is checkpoint-commit + reload +
+    # verify, and a supervisor poll is file tailing — both are sized by
+    # how many rescales/stalls the round happened to drive (one-off
+    # rescale spikes are the MECHANISM working, not a regression)
+    "elastic.rescale",
+    "supervisor.poll",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
