@@ -1217,6 +1217,42 @@ def _attach_elastic(record: dict) -> None:
             print(f"elastic probe failed: {e}", file=sys.stderr)
 
 
+def _attach_ensemble(record: dict) -> None:
+    """Fold the scenario-multiplexing sweep (ISSUE 9) into the record
+    under ``detail.telemetry.ensemble``: scenarios·steps/sec/chip for
+    cohort sizes {1, 8, 64, 256} vs solo stepping — the serving
+    headline beside cell-updates/sec.  Run on the 8-device virtual CPU
+    mesh in a child so an accelerator outage never blocks the bench
+    line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from benchmarks.microbench import ensemble_summary; "
+        "print(json.dumps(ensemble_summary()))"
+        % str(ROOT)
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            print(f"ensemble probe failed: {r.stderr[-300:]}",
+                  file=sys.stderr)
+            return
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        record.setdefault("detail", {}).setdefault(
+            "telemetry", {})["ensemble"] = json.loads(line)
+    except Exception as e:  # noqa: BLE001 - never kills the bench
+        print(f"ensemble probe failed: {e}", file=sys.stderr)
+
+
 def _attach_telemetry(record: dict) -> None:
     """Fold telemetry.json's phase breakdown into the bench record so
     BENCH_*.json rounds carry where epoch/halo/LB/AMR/checkpoint time
@@ -1319,6 +1355,7 @@ def _emit(record: dict):
     _attach_epoch_churn(record)
     _attach_halo_overlap(record)
     _attach_elastic(record)
+    _attach_ensemble(record)
     try:
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
     except OSError as e:

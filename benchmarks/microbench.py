@@ -593,6 +593,111 @@ def elastic_summary(length: int = 6, seed: int = 0) -> dict:
     }
 
 
+def ensemble_summary(length: int = 4, steps: int = 20,
+                     sizes=(1, 8, 64, 256), seed: int = 0) -> dict:
+    """Scenario-multiplexing throughput (ISSUE 9): scenarios·steps/sec
+    per chip for cohort sizes ``sizes`` vs solo stepping, importable so
+    ``bench.py`` folds it into ``detail.telemetry.ensemble``.
+
+    One GoL grid on the general gather path (the representative
+    runtime-argument form — every member's tables ride the stacked
+    leading axis); ``B`` independent initial conditions admitted into
+    one cohort and stepped through the single compiled cohort body.
+    ``solo`` is the same model's own step loop — the baseline a tenant
+    would get with the hardware to itself.  ``amortization`` per cohort
+    size is the cohort's scenarios·steps/sec over solo's: how many
+    near-free scenarios the leading axis buys on this backend."""
+    import jax
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.serve import Scenario, Scheduler
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / length,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    g.stop_refining()
+    gol = GameOfLife(g, allow_dense=False)
+    cells = g.get_cells()
+    rng = np.random.default_rng(seed)
+
+    def fresh_state():
+        return gol.new_state(
+            alive_cells=cells[rng.random(len(cells)) < 0.3]
+        )
+
+    def sync(sched):
+        for cohort in sched.cohorts.values():
+            jax.block_until_ready(cohort._state)
+
+    # solo baseline: the model's own step loop, one scenario
+    state = fresh_state()
+    s = gol.step(state)
+    jax.block_until_ready(s["is_alive"])          # warm the compile
+    t0 = time.perf_counter()
+    s = state
+    for _ in range(steps):
+        s = gol.step(s)
+    jax.block_until_ready(s["is_alive"])
+    solo_s = (time.perf_counter() - t0) / steps
+    chips = max(g.n_devices, 1)
+    solo_rate = 1.0 / max(solo_s, 1e-12) / chips
+
+    out: dict = {
+        "model": "gol",
+        "n_devices": g.n_devices,
+        "n_cells": int(len(cells)),
+        "steps": steps,
+        "solo_step_s": round(solo_s, 6),
+        "solo_scenario_steps_per_s_per_chip": round(solo_rate, 1),
+        "cohorts": {},
+    }
+    for B in sizes:
+        sched = Scheduler()
+        for i in range(B):
+            sched.submit(Scenario(gol, fresh_state(), steps + 1,
+                                  tenant=f"t{i}"))
+        sched.admit()
+        sched.step_once()                         # warm the cohort body
+        sync(sched)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            sched.step_once()
+        sync(sched)
+        step_s = (time.perf_counter() - t0) / steps
+        rate = B / max(step_s, 1e-12) / chips
+        out["cohorts"][str(B)] = {
+            "cohort_step_s": round(step_s, 6),
+            "scenarios_steps_per_s_per_chip": round(rate, 1),
+            "amortization_vs_solo": round(rate / max(solo_rate, 1e-12),
+                                          2),
+        }
+    return out
+
+
+def bench_ensemble(length: int = 4, steps: int = 20):
+    """Print the :func:`ensemble_summary` sweep as a bench metric:
+    value = scenarios·steps/sec/chip at the largest cohort size — the
+    serving-throughput headline beside cell-updates/sec."""
+    s = ensemble_summary(length=length, steps=steps)
+    largest = max(s["cohorts"], key=int)
+    print(json.dumps({
+        "metric": "ensemble_scenarios_steps_per_sec_per_chip",
+        "value": s["cohorts"][largest]["scenarios_steps_per_s_per_chip"],
+        "unit": f"scenarios*steps/s/chip (cohort {largest})",
+        "detail": s,
+    }))
+
+
 def halo_overlap_summary(steps: int = 20, length: int = 8, reps: int = 3,
                          seed: int = 0, profile: bool = True) -> dict:
     """Eager vs host-split vs fused split-phase stepping per model
@@ -864,6 +969,7 @@ def main():
     bench_epoch_churn(args.churn_length)
     bench_churn_compile()
     bench_halo_overlap()
+    bench_ensemble()
     bench_particles(args.particles)
 
 

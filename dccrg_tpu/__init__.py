@@ -7,6 +7,7 @@ replicated grid/AMR metadata, and native load balancing in place of Zoltan.
 """
 from . import obs
 from . import resilience
+from . import serve
 from .core.mapping import ERROR_CELL, ERROR_INDEX, Mapping
 from .core.topology import Topology
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
@@ -26,6 +27,7 @@ __all__ = [
     "make_mesh",
     "obs",
     "resilience",
+    "serve",
 ]
 
 __version__ = "0.1.0"

@@ -750,6 +750,7 @@ class Advection:
             str(np.dtype(self.dtype)), pallas_mode,
             tuple(np.asarray(l0, np.float64).tolist()),
         )
+        self._dense_key = key
         bundle = self.grid.exec_cache.get(key, self._build_dense_bundle)
         self._step = bundle["step"]
         self._fused_run = bundle["fused_run"]
@@ -1138,6 +1139,41 @@ class Advection:
 
     def step(self, state, dt):
         return self._step(state, dt)
+
+    def batch_step_spec(self):
+        """This model's step entry point in cohort-batchable form
+        (ISSUE 9): the compiled member program plus its runtime-argument
+        tables, so ``dccrg_tpu/serve`` can stack many same-signature
+        scenarios on a leading axis and vmap one jitted cohort body over
+        them.  Works for the dense fast path (tables are closed-over
+        pure functions of the kernel key) and both general gather forms
+        (tables ride along per member as stacked arguments)."""
+        from ..parallel.exec_cache import BatchStepSpec
+
+        dtype = np.dtype(self.dtype)
+        if self.dense is not None:
+            step = self._step
+            return BatchStepSpec(
+                kind="advection.dense", kernel_key=self._dense_key,
+                call=lambda args, state, dt: step(state, dt),
+                args=(), dt_dtype=dtype,
+            )
+        if self.overlap:
+            fn = self._split_fn
+            return BatchStepSpec(
+                kind="advection.split",
+                kernel_key=self._kernel_key("advection.split_step"),
+                call=lambda args, state, dt: fn(*args, state, dt),
+                args=self._split_args, dt_dtype=dtype,
+            )
+        fn = self._step_fn
+        return BatchStepSpec(
+            kind="advection",
+            kernel_key=self._kernel_key("advection.step"),
+            call=lambda args, state, dt: fn(*args, state, dt),
+            args=(self._rings, self.tables.tree(), self._dev),
+            dt_dtype=dtype,
+        )
 
     def _record_run(self, path: str, steps, state) -> None:
         """Post-run reconciliation (obs.fused): the whole-run paths keep

@@ -102,10 +102,15 @@ class GameOfLife:
                 nbr_alive = gather_neighbors(
                     alive, tables["nbr_rows"]
                 )                                                   # [D,R,K]
+                # dtype pinned to the SPEC's uint32 (like the overlap
+                # step): without it jnp.sum promotes to uint64 under
+                # x64, so the step's OUTPUT state has a different aval
+                # than its input and the second dispatch of any program
+                # taking the state re-traces once
                 count = jnp.sum(
                     jnp.where(tables["nbr_valid"],
                               (nbr_alive > 0).astype(jnp.uint32), 0),
-                    axis=-1,
+                    axis=-1, dtype=jnp.uint32,
                 )
                 new_alive = _life_rule(count, alive)
                 local = tables["local_mask"]
@@ -120,6 +125,8 @@ class GameOfLife:
 
         fn = self.grid.exec_cache.get(("gol.step", ex.structure_key), build)
         tables = self.tables.tree()
+        self._step_fn = fn
+        self._step_args = (rings, tables)
         return lambda state: fn(rings, tables, state)
 
     def _build_overlap_step(self):
@@ -231,6 +238,8 @@ class GameOfLife:
         fn = self.grid.exec_cache.get(
             ("gol.overlap_step", halo.structure_key), build
         )
+        self._overlap_fn = fn
+        self._overlap_args = (rings, tabs, local)
 
         def step(state):
             out_a, cnt = fn(rings, tabs, local, state["is_alive"])
@@ -388,6 +397,33 @@ class GameOfLife:
 
     def step(self, state):
         return self._step(state)
+
+    def batch_step_spec(self):
+        """Cohort-batchable step entry point (ISSUE 9; see
+        ``Advection.batch_step_spec``).  GoL takes no dt — the cohort's
+        per-member dt operand is ignored."""
+        from ..parallel.exec_cache import BatchStepSpec
+
+        ex = self._exchange
+        if self.tables is None:          # overlap=True split-phase form
+            fn = self._overlap_fn
+
+            def call(args, state, dt):
+                out_a, cnt = fn(args[0], args[1], args[2],
+                                state["is_alive"])
+                return {"is_alive": out_a, "live_neighbor_count": cnt}
+
+            return BatchStepSpec(
+                kind="gol.overlap",
+                kernel_key=("gol.overlap_step", ex.structure_key),
+                call=call, args=self._overlap_args,
+            )
+        fn = self._step_fn
+        return BatchStepSpec(
+            kind="gol", kernel_key=("gol.step", ex.structure_key),
+            call=lambda args, state, dt: fn(args[0], args[1], state),
+            args=self._step_args,
+        )
 
     def run(self, state, turns: int, sync_every: int = 16):
         """Advance ``turns`` steps.  On the dense 2-D fast path the whole

@@ -94,6 +94,7 @@ class Vlasov:
             str(np.dtype(self.dtype)), pallas_mode,
             tuple(np.asarray(l0, np.float64).tolist()),
         )
+        self._dense_key = key
         bundle = self.grid.exec_cache.get(key, self._build_dense_bundle)
         self._fused_block = bundle["fused_block"]
         self._step_xla, self._run_xla = bundle["step_xla"], bundle["run_xla"]
@@ -352,6 +353,8 @@ class Vlasov:
         )
         vbT = jnp.asarray(self.v_bins.T, dtype)
         args = (rings, t, dev, vbT, bnd_pos_dev, bnd_neg_dev)
+        self._has_open = has_open
+        self._gen_fn, self._gen_args = step_fn, args
         self._step = self._step_xla = (
             lambda state, dt: step_fn(*args, state, dt)
         )
@@ -477,6 +480,7 @@ class Vlasov:
         )
         vbT = jnp.asarray(self.v_bins.T, dtype)
         args = (rings, inner, outer, local, vbT)
+        self._split_fn_k, self._split_args = step_fn, args
         self._step = lambda state, dt: step_fn(*args, state, dt)
         self._run = lambda state, steps, dt: run_fn(*args, state, steps,
                                                     dt)
@@ -521,6 +525,40 @@ class Vlasov:
                 self._disable_fused, state, dt,
             )
         return self._step(state, dt)
+
+    def batch_step_spec(self):
+        """Cohort-batchable step entry point (ISSUE 9; see
+        ``Advection.batch_step_spec``).  ``nv`` rides the kernel key:
+        two cohorts with different velocity-space resolutions compile
+        different member programs even at one spatial signature."""
+        from ..parallel.exec_cache import BatchStepSpec
+
+        dtype = np.dtype(self.dtype)
+        if self.info is not None:
+            step = self._step
+            return BatchStepSpec(
+                kind="vlasov.dense", kernel_key=self._dense_key,
+                call=lambda args, state, dt: step(state, dt),
+                args=(), dt_dtype=dtype,
+            )
+        ex = self._exchange
+        if self.overlap:
+            fn = self._split_fn_k
+            return BatchStepSpec(
+                kind="vlasov.split",
+                kernel_key=("vlasov.split_step", ex.structure_key,
+                            str(dtype), self._has_open, self.nv),
+                call=lambda args, state, dt: fn(*args, state, dt),
+                args=self._split_args, dt_dtype=dtype,
+            )
+        fn = self._gen_fn
+        return BatchStepSpec(
+            kind="vlasov",
+            kernel_key=("vlasov.step", ex.structure_key, str(dtype),
+                        self._has_open, self.nv),
+            call=lambda args, state, dt: fn(*args, state, dt),
+            args=self._gen_args, dt_dtype=dtype,
+        )
 
     def _record_run(self, path: str, steps, state) -> None:
         """Post-run reconciliation (obs.fused): the device-loop runs keep

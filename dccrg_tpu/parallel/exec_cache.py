@@ -50,11 +50,14 @@ import re
 import threading
 import time
 from collections import OrderedDict
+from typing import NamedTuple
 
 from ..obs.registry import metrics as _metrics
 
 __all__ = [
     "ExecutableCache",
+    "BatchStepSpec",
+    "cohort_key",
     "traced_jit",
     "note_trace",
     "trace_counts",
@@ -65,6 +68,49 @@ __all__ = [
     "persistent_cache_dir",
     "persistent_cache_counts",
 ]
+
+
+class BatchStepSpec(NamedTuple):
+    """A model's step entry point in cohort-batchable form (ISSUE 9).
+
+    Post-PR 5 every epoch-derived table enters the step kernels as a
+    runtime ARGUMENT, so batching independent same-shape scenarios is a
+    leading-axis stack of ``(args, state, dt)`` triples — not a retrace.
+    Each supported model exposes ``batch_step_spec()`` returning one of
+    these; the ensemble front-end (``dccrg_tpu/serve/``) stacks the
+    per-member ``args``/state and vmaps ``call`` over them inside one
+    jitted cohort program.
+
+    * ``kind`` — short model tag (``"gol"``, ``"advection"``, ...);
+      rides kernel labels (``ensemble.step.<kind>``) and telemetry.
+    * ``kernel_key`` — hashable identity of the member program:
+      everything its trace depends on besides argument shapes (halo
+      ``structure_key``, dtype, dense dims...).  Two models with EQUAL
+      keys compile the same program, so a cohort may apply the template
+      member's ``call`` to every member's ``(args, state, dt)`` — that
+      is the admission criterion, refining the grid-level
+      :class:`~dccrg_tpu.parallel.shapes.ShapeSignature` cohort key.
+    * ``call`` — ``call(args, state, dt) -> state``, pure and traceable
+      (vmap rides over it); models that take no dt ignore the operand.
+    * ``args`` — this member's runtime-argument pytree (halo ring
+      tables, gather/face tables...).  Empty for closure-based dense
+      fast paths, whose tables are pure functions of the kernel_key.
+    * ``dt_dtype`` — dtype the member expects dt in (None = unused).
+    """
+
+    kind: str
+    kernel_key: tuple
+    call: object
+    args: tuple = ()
+    dt_dtype: object = None
+
+
+def cohort_key(spec: "BatchStepSpec", width: int) -> tuple:
+    """Executable-cache key of a cohort-batched step body: the member
+    program's identity plus the stacked leading-axis width (the only
+    extra dimension the batched trace depends on — occupancy churn at a
+    held width re-dispatches, never retraces)."""
+    return ("ensemble.step", spec.kind, spec.kernel_key, int(width))
 
 
 def mesh_key(mesh):

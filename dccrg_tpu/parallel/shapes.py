@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import math
 import os
+import zlib
 from typing import NamedTuple
 
 __all__ = [
@@ -154,6 +155,18 @@ class ShapeSignature(NamedTuple):
     kmax: tuple           # sorted ((hood_key, Kmax), ...)
     dense: bool           # dense fast path detected
     rings: tuple = ()     # sorted ((hood_key, field, k, S_k), ...)
+
+    def label(self) -> str:
+        """Short deterministic telemetry label for this signature —
+        stable ACROSS PROCESSES AND ROUNDS (unlike ``hash()``, which is
+        salted per interpreter), so labeled series such as
+        ``ensemble.cohort_occupancy{signature=...}`` line up between a
+        bench round and its baseline.  Leading fields stay readable
+        (device count, rows, dense flag); the kmax/ring structure is
+        folded into a CRC so the label stays one short token."""
+        crc = zlib.crc32(repr((self.kmax, self.rings)).encode())
+        return (f"d{self.n_devices}.R{self.R}."
+                f"{'dense' if self.dense else 'gather'}.{crc:08x}")
 
 
 def ring_signature(ring_hints) -> tuple:

@@ -57,6 +57,7 @@ from .elastic import (
     ElasticPolicy,
     RescaleResult,
     available_devices,
+    queue_depth_signal,
     rescale,
     step_latency_signal,
     utilization_signal,
@@ -75,6 +76,7 @@ __all__ = [
     "ElasticPolicy",
     "RescaleResult",
     "available_devices",
+    "queue_depth_signal",
     "rescale",
     "step_latency_signal",
     "utilization_signal",

@@ -51,6 +51,7 @@ __all__ = [
     "ElasticPolicy",
     "utilization_signal",
     "step_latency_signal",
+    "queue_depth_signal",
 ]
 
 
@@ -177,6 +178,41 @@ def step_latency_signal(target_s: float, phase: str = "halo.exchange",
     if not rec or target_s <= 0:
         return None
     return rec["mean_s"] / float(target_s)
+
+
+def queue_depth_signal(source, target_depth: int | None = None,
+                       registry=None) -> float | None:
+    """Ensemble-backlog load signal (ISSUE 9 — the follow-on PR 8 left
+    the policy waiting on): the serving scheduler's queue depth as a
+    fraction of ``target_depth``.  1.0 = exactly the backlog the fleet
+    is sized for; the policy's watermark-gap + patience hysteresis then
+    applies unchanged, so an oscillating queue never flaps the fleet.
+
+    ``source`` is anything that can yield a depth: a
+    :class:`~dccrg_tpu.serve.Scheduler`/:class:`~dccrg_tpu.serve.
+    Ensemble` (``queue_depth()`` is called), a bare callable, a plain
+    number, or None — None falls back to the ``ensemble.queue_depth``
+    gauge in ``registry`` (default: the process registry), which the
+    scheduler refreshes on every submit/admit tick.  Returns None when
+    no depth is observable (the policy then holds), and
+    ``target_depth`` defaults to ``DCCRG_ELASTIC_QUEUE_TARGET`` (8)."""
+    if target_depth is None:
+        target_depth = _env_int("DCCRG_ELASTIC_QUEUE_TARGET", 8)
+    if target_depth <= 0:
+        return None
+    depth = None
+    if source is None:
+        reg = registry if registry is not None else metrics
+        depth = reg.gauge_value("ensemble.queue_depth")
+    elif callable(getattr(source, "queue_depth", None)):
+        depth = source.queue_depth()
+    elif callable(source):
+        depth = source()
+    elif isinstance(source, (int, float)):
+        depth = source
+    if depth is None:
+        return None
+    return float(depth) / float(target_depth)
 
 
 def _env_float(name: str, default: float) -> float:

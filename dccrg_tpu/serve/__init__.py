@@ -1,0 +1,30 @@
+"""Ensemble serving front-end (ISSUE 9): multiplex thousands of
+independent same-signature scenarios through one compiled executable.
+
+See :mod:`dccrg_tpu.serve.ensemble` for the design; the short version:
+
+* :class:`Ensemble` — submit ``(model, state, steps)`` scenarios, run
+  the loop, read bit-identical-to-solo results;
+* :class:`Scheduler` — the admission/retirement engine beneath it,
+  whose :meth:`~Scheduler.queue_depth` feeds the elastic policy;
+* :class:`Cohort` — one signature's stacked member fleet and its single
+  jitted step body;
+* ``DCCRG_ENSEMBLE_VERIFY=1`` — the solo-replay byte-compare oracle.
+"""
+from .ensemble import (
+    Cohort,
+    Ensemble,
+    Scenario,
+    Scheduler,
+    cohort_width,
+    verify_enabled,
+)
+
+__all__ = [
+    "Cohort",
+    "Ensemble",
+    "Scenario",
+    "Scheduler",
+    "cohort_width",
+    "verify_enabled",
+]

@@ -1,0 +1,665 @@
+"""Ensemble serving: thousands of independent scenarios per executable.
+
+The production story for "millions of users" is not one giant grid — it
+is many independent simulation instances (parameter sweeps, per-user
+scenarios, Monte Carlo ensembles) multiplexed onto shared hardware, the
+"rapid and flexible simulation development" use case the dccrg paper
+targets (Honkonen et al., CPC 2013).  PR 5 made the multiplexing
+tractable: bucketed table shapes mean independent grids land on a
+*shared* :class:`~dccrg_tpu.parallel.shapes.ShapeSignature`, and PR 8's
+``ShapeSignature.rings`` made ``grid.shape_signature()`` alone predict
+executable-cache behavior — so ONE compiled program can serve a whole
+fleet.  This module is the front-end that exploits it:
+
+* **Cohorts** group admitted scenarios by signature (refined by the
+  member program's :class:`~dccrg_tpu.parallel.exec_cache.BatchStepSpec`
+  ``kernel_key``) and step every member through a single jitted cohort
+  body: ``jax.vmap`` over a leading member axis of the stacked
+  ``(args, state, dt)`` triples.  The tables are already kernel
+  ARGUMENTS post-PR 5, so batching is a leading-axis stack — members
+  may carry *different* table contents (different AMR patterns at one
+  signature) without retracing anything.
+
+* **Admission/retirement never retrace**: cohort widths ride a
+  power-of-two ladder with shrink hysteresis (the
+  ``parallel/shapes.py`` discipline), inactive slots are masked by a
+  runtime-argument occupancy mask, and admitting or retiring a member
+  is an ``.at[slot].set`` / slice on the stacked arrays — the cohort
+  executable is keyed only by ``(kernel_key, width)``
+  (:func:`~dccrg_tpu.parallel.exec_cache.cohort_key`), so occupancy
+  churn at a held width re-dispatches, never recompiles.
+
+* **Scheduler** runs the request queue: scenarios are admitted into the
+  matching cohort, cohorts step round-robin or by earliest member
+  deadline, finished members retire without disturbing the rest, and
+  the backlog depth feeds :func:`~dccrg_tpu.resilience.elastic.
+  queue_depth_signal` (the PR 8 follow-on).
+
+* **Per-tenant telemetry** through ``obs/``: counters
+  ``ensemble.admitted`` / ``ensemble.retired`` /
+  ``ensemble.rejected{reason}`` / ``ensemble.steps_served{tenant}``,
+  gauges ``ensemble.queue_depth`` and
+  ``ensemble.cohort_occupancy{signature}`` (occupied fraction of the
+  cohort width, labeled by the cross-process-stable
+  ``ShapeSignature.label()``), the ``ensemble.queue_latency`` histogram
+  (submit → admit seconds), and the ``ensemble.admit`` /
+  ``ensemble.step`` phases.
+
+Correctness anchor: a cohort-stepped scenario is **bit-identical** to
+the same member stepped solo through its own model kernel (vmap batches
+the member program without reassociating its arithmetic).  The
+always-available oracle — ``DCCRG_ENSEMBLE_VERIFY=1``, or
+``Ensemble(verify=True)`` — replays one sampled active member solo per
+cohort step and byte-compares every field; mismatches are COUNTED
+(``ensemble.verify_mismatches{field}`` under the ``ensemble.verify``
+phase), never raised, mirroring the halo/epoch oracle protocol.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+
+import numpy as np
+
+from ..obs.registry import metrics
+from ..parallel.exec_cache import BatchStepSpec, cohort_key, traced_jit
+from ..parallel.mesh import SHARD_AXIS
+
+__all__ = [
+    "Scenario",
+    "Cohort",
+    "Scheduler",
+    "Ensemble",
+    "cohort_width",
+    "verify_enabled",
+]
+
+
+def verify_enabled() -> bool:
+    """Whether the solo-replay oracle is armed process-wide
+    (``DCCRG_ENSEMBLE_VERIFY=1``)."""
+    return os.environ.get("DCCRG_ENSEMBLE_VERIFY", "0") == "1"
+
+
+def _shrink() -> float:
+    try:
+        s = float(os.environ.get("DCCRG_ENSEMBLE_SHRINK", 0.5))
+    except ValueError:
+        return 0.5
+    return min(max(s, 0.0), 1.0)
+
+
+def cohort_width(n: int, prev: int | None = None) -> int:
+    """Cohort slot budget for ``n`` members: the next power of two, with
+    shrink hysteresis against the held width ``prev`` — occupancy
+    wiggling around a ladder boundary must not flap the stacked shapes
+    (each width is its own compiled cohort body).  Idempotent, like the
+    ``parallel/shapes.py`` buckets: ``cohort_width(w, w) == w``."""
+    n = max(int(n), 1)
+    w = 1
+    while w < n:
+        w *= 2
+    if prev is not None and prev >= w:
+        if w == prev or n >= _shrink() * prev:
+            return prev
+    return w
+
+
+class Scenario:
+    """One admitted (or pending) simulation instance.
+
+    ``model`` is a bound workload instance (``Advection`` / ``GameOfLife``
+    / ``Vlasov``) exposing ``batch_step_spec()``; ``state`` its state
+    pytree; ``steps`` how many steps to serve; ``dt`` the member's own
+    timestep (ignored by models that take none); ``deadline`` an
+    optional absolute time used by the deadline scheduling policy.
+
+    Lifecycle: ``queued`` → ``active`` → ``done`` (``result`` holds the
+    final state pytree), or ``rejected`` (``reject_reason`` says why —
+    counted, never raised)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, model, state, steps: int, dt=None,
+                 tenant: str = "default", deadline: float | None = None):
+        self.id = next(Scenario._ids)
+        self.model = model
+        self.state = state
+        self.steps = int(steps)
+        self.dt = dt
+        self.tenant = str(tenant)
+        self.deadline = deadline
+        self.status = "queued"
+        self.reject_reason = None
+        self.steps_done = 0
+        self.result = None
+        self.submitted_at = time.perf_counter()
+        self.admitted_at = None
+        #: filled at submit: the member program + per-member tables
+        self.spec: BatchStepSpec | None = None
+        self.signature = None
+
+    @property
+    def remaining(self) -> int:
+        return max(self.steps - self.steps_done, 0)
+
+
+def _state_sig(state) -> tuple:
+    """Hashable structure+shape+dtype identity of a state pytree — the
+    defensive refinement of the cohort key (equal kernel keys imply
+    compatible shapes, but the stacked buffers need exact equality)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return (str(treedef),) + tuple(
+        (tuple(x.shape), str(np.asarray(x).dtype) if not hasattr(x, "dtype")
+         else str(x.dtype)) for x in leaves
+    )
+
+
+class Cohort:
+    """A fleet of same-program scenarios stepping as one stacked batch.
+
+    Holds ``[W, ...]``-stacked member args and state (leading axis =
+    member slot, sharded ``[W, D, ...]`` on the device axis beneath),
+    host-side occupancy bookkeeping, and the compiled cohort body from
+    the template grid's executable cache.  Admission writes a member
+    into a free slot; retirement slices its final state out; neither
+    touches the compiled program."""
+
+    def __init__(self, scenario: Scenario, width: int | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        spec = scenario.spec
+        self.spec = spec
+        self.signature = scenario.signature
+        self.sig_label = (self.signature.label()
+                          if self.signature is not None else "unknown")
+        grid = scenario.model.grid
+        self.mesh = grid.mesh
+        self.exec_cache = grid.exec_cache
+        self.W = cohort_width(1) if width is None else int(width)
+        self.state_sig = _state_sig(scenario.state)
+        self.dt_dtype = np.dtype(spec.dt_dtype
+                                 if spec.dt_dtype is not None
+                                 else np.float32)
+        self.members: list = [None] * self.W
+        self._remaining = np.zeros(self.W, np.int64)
+        self._occupied = np.zeros(self.W, bool)
+        self._dts = np.zeros(self.W, self.dt_dtype)
+        # stacked runtime arguments and state: slot 0's values replicated
+        # as padding (pad slots are masked, their contents only need to
+        # be shape-compatible and finite)
+        self._args = jax.tree_util.tree_map(
+            lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
+            spec.args,
+        )
+        self._state = jax.tree_util.tree_map(
+            lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
+            scenario.state,
+        )
+        self._kernel = self._build_kernel()
+        self._verify_rr = 0
+        #: highest occupied fraction this cohort ever reached — the
+        #: monotone series the telemetry floor gate watches (live
+        #: occupancy legitimately returns to 0 after retirement)
+        self.peak_occupancy = 0.0
+
+    # ------------------------------------------------------------ device
+
+    def _put(self, stacked):
+        """Shard a ``[W, D, ...]`` stacked leaf on the device axis (axis
+        1 — the member axis is replicated).  ``[W]``-only leaves stay
+        replicated."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if stacked.ndim < 2:
+            return stacked
+        try:
+            spec = P(None, SHARD_AXIS, *([None] * (stacked.ndim - 2)))
+            return jax.device_put(stacked, NamedSharding(self.mesh, spec))
+        except Exception:  # noqa: BLE001 — fall back to default placement
+            return stacked
+
+    def _build_kernel(self):
+        """The compiled cohort body: vmap of the member program over the
+        stacked leading axis, inactive slots frozen by the runtime
+        occupancy mask.  Cached under ``(kernel_key, W)`` — the only
+        dimensions the batched trace depends on — so admission and
+        retirement at a held width re-dispatch this executable."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        call = spec.call
+
+        def build():
+            def cohort_step(args, state, dts, mask):
+                stepped = jax.vmap(call, in_axes=(0, 0, 0))(
+                    args, state, dts
+                )
+
+                def freeze(new, old):
+                    m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
+                    return jnp.where(m, new, old)
+
+                return jax.tree_util.tree_map(freeze, stepped, state)
+
+            return traced_jit(f"ensemble.step.{spec.kind}", cohort_step)
+
+        return self.exec_cache.get(cohort_key(spec, self.W), build)
+
+    # -------------------------------------------------------- membership
+
+    def compatible(self, scenario: Scenario) -> bool:
+        return (scenario.spec is not None
+                and scenario.spec.kind == self.spec.kind
+                and scenario.spec.kernel_key == self.spec.kernel_key
+                and _state_sig(scenario.state) == self.state_sig)
+
+    def free_slots(self) -> np.ndarray:
+        return np.flatnonzero(~self._occupied)
+
+    @property
+    def occupancy(self) -> int:
+        return int(self._occupied.sum())
+
+    def admit(self, scenario: Scenario, slot: int) -> None:
+        """Write one member into ``slot``: its runtime tables, state and
+        dt land in the stacked arrays; shapes never change, so nothing
+        retraces."""
+        import jax
+
+        slot = int(slot)
+        if self._occupied[slot]:
+            raise ValueError(f"slot {slot} already occupied")
+        self.members[slot] = scenario
+        self._occupied[slot] = True
+        self._remaining[slot] = scenario.remaining
+        self._dts[slot] = (self.dt_dtype.type(scenario.dt)
+                           if scenario.dt is not None else 0)
+        set_slot = lambda S, x: S.at[slot].set(x)
+        self._args = jax.tree_util.tree_map(
+            set_slot, self._args, scenario.spec.args
+        )
+        self._state = jax.tree_util.tree_map(
+            set_slot, self._state, scenario.state
+        )
+        scenario.status = "active"
+        scenario.admitted_at = time.perf_counter()
+        self.peak_occupancy = max(self.peak_occupancy,
+                                  self.occupancy / max(self.W, 1))
+
+    def member_state(self, slot: int):
+        """The current state pytree of one slot (a device-array slice)."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda S: S[int(slot)], self._state)
+
+    def retire(self, slot: int) -> Scenario:
+        """Free one slot: slice the member's final state out of the
+        stack and hand the finished scenario back.  The other members'
+        rows are untouched and the compiled body unchanged."""
+        slot = int(slot)
+        scn = self.members[slot]
+        scn.result = self.member_state(slot)
+        scn.status = "done"
+        self.members[slot] = None
+        self._occupied[slot] = False
+        self._remaining[slot] = 0
+        return scn
+
+    def finished_slots(self) -> np.ndarray:
+        return np.flatnonzero(self._occupied & (self._remaining <= 0))
+
+    def min_deadline(self) -> float:
+        dls = [m.deadline for m in self.members
+               if m is not None and m.deadline is not None]
+        return min(dls) if dls else float("inf")
+
+    # -------------------------------------------------------------- step
+
+    def active_mask(self) -> np.ndarray:
+        return self._occupied & (self._remaining > 0)
+
+    def step(self) -> int:
+        """One cohort step: every occupied slot with remaining work
+        advances by its own dt inside the single compiled dispatch;
+        inactive and exhausted slots are frozen by the mask.  Returns
+        how many members stepped."""
+        import jax.numpy as jnp
+
+        mask = self.active_mask()
+        n = int(mask.sum())
+        if n == 0:
+            return 0
+        pre = self._state if self._verify_active() else None
+        dts = jnp.asarray(self._dts)
+        mdev = jnp.asarray(mask)
+        with metrics.phase("ensemble.step"):
+            self._state = self._kernel(self._args, self._state, dts, mdev)
+        self._remaining[mask] -= 1
+        if metrics.enabled:
+            served: dict = {}
+            for slot in np.flatnonzero(mask):
+                scn = self.members[slot]
+                scn.steps_done += 1
+                served[scn.tenant] = served.get(scn.tenant, 0) + 1
+            metrics.inc_many([
+                ("ensemble.steps_served", v, {"tenant": t})
+                for t, v in served.items()
+            ])
+        else:
+            for slot in np.flatnonzero(mask):
+                self.members[slot].steps_done += 1
+        if pre is not None:
+            self._verify(pre, mask)
+        return n
+
+    # ------------------------------------------------------------ oracle
+
+    def _verify_active(self) -> bool:
+        return self._verify_on if hasattr(self, "_verify_on") \
+            else verify_enabled()
+
+    def _verify(self, pre_state, mask: np.ndarray) -> int:
+        """Replay ONE sampled active member solo through its own member
+        program (the model's cached step kernel — the always-available
+        oracle) and byte-compare every field of its cohort row.
+        Mismatches are counted, never raised; the sample rotates
+        round-robin over active slots so every member is eventually
+        audited.  Returns the mismatch count (tests read it)."""
+        import jax
+
+        slots = np.flatnonzero(mask)
+        if len(slots) == 0:
+            return 0
+        t0 = time.perf_counter()
+        slot = int(slots[self._verify_rr % len(slots)])
+        self._verify_rr += 1
+        take = lambda S: S[slot]
+        member_pre = jax.tree_util.tree_map(take, pre_state)
+        member_args = jax.tree_util.tree_map(take, self._args)
+        dt = self.dt_dtype.type(self._dts[slot])
+        solo = self.spec.call(member_args, member_pre, dt)
+        got = jax.tree_util.tree_map(take, self._state)
+        names = sorted(solo) if isinstance(solo, dict) else None
+        solo_l = jax.tree_util.tree_leaves(solo)
+        got_l = jax.tree_util.tree_leaves(got)
+        mismatches = 0
+        for i, (a, b) in enumerate(zip(solo_l, got_l)):
+            if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+                mismatches += 1
+                labels = {"field": names[i]} if names else {}
+                metrics.inc("ensemble.verify_mismatches", **labels)
+        metrics.inc("ensemble.verify_checks", len(solo_l))
+        metrics.phase_add("ensemble.verify", time.perf_counter() - t0)
+        return mismatches
+
+
+class Scheduler:
+    """Admission/retirement loop over signature-keyed cohorts.
+
+    ``submit`` enqueues; :meth:`admit` drains the queue into matching
+    cohorts (creating or growing them along the width ladder);
+    :meth:`step_once` steps every cohort with active members in policy
+    order (``round_robin`` or ``deadline`` — earliest member deadline
+    first) and retires finished members.  :meth:`queue_depth` is the
+    backlog signal the elastic policy consumes
+    (:func:`~dccrg_tpu.resilience.elastic.queue_depth_signal`)."""
+
+    def __init__(self, policy: str = "round_robin",
+                 max_width: int | None = None,
+                 max_cohorts: int | None = None,
+                 verify: bool | None = None):
+        if policy not in ("round_robin", "deadline"):
+            raise ValueError(f"unknown scheduling policy {policy!r}")
+        self.policy = policy
+        self.max_width = (int(max_width) if max_width is not None
+                          else _env_int("DCCRG_ENSEMBLE_MAX_COHORT", 1024))
+        self.max_cohorts = max_cohorts
+        self.verify = verify
+        self._queue: deque = deque()
+        self.cohorts: dict = {}
+        self._rr = 0
+        self.completed: list = []
+        #: held width per cohort key (the hysteresis hints of the
+        #: width ladder — survive cohort teardown like grid ring hints)
+        self._width_hints: dict = {}
+
+    # ---------------------------------------------------------- requests
+
+    def submit(self, scenario: Scenario) -> Scenario:
+        """Enqueue one scenario, resolving its batch spec and signature.
+        Invalid or unsupported requests are REJECTED (counted under
+        ``ensemble.rejected{reason}``), never raised — the serving loop
+        must survive any single bad request."""
+        reason = None
+        if scenario.steps <= 0:
+            reason = "invalid"
+        elif not hasattr(scenario.model, "batch_step_spec"):
+            reason = "unsupported"
+        else:
+            try:
+                scenario.spec = scenario.model.batch_step_spec()
+                scenario.signature = scenario.model.grid.shape_signature()
+            except Exception:  # noqa: BLE001 — unsupported path/model
+                reason = "unsupported"
+        if reason is not None:
+            scenario.status = "rejected"
+            scenario.reject_reason = reason
+            metrics.inc("ensemble.rejected", reason=reason)
+            return scenario
+        self._queue.append(scenario)
+        metrics.gauge("ensemble.queue_depth", self.queue_depth())
+        return scenario
+
+    def queue_depth(self) -> int:
+        """Backlog: submitted-but-not-admitted scenarios.  This is the
+        load signal the PR 8 elastic policy was left waiting on."""
+        return len(self._queue)
+
+    def _cohort_id(self, scn: Scenario) -> tuple:
+        return (scn.signature, scn.spec.kind, scn.spec.kernel_key,
+                _state_sig(scn.state))
+
+    # --------------------------------------------------------- admission
+
+    def _grow(self, key, cohort: Cohort, need: int) -> Cohort:
+        """Re-land a full cohort at the next ladder width: members keep
+        their CURRENT stacked state (extracted per slot and re-admitted),
+        so growth mid-flight is loss-free.  The wider body compiles once
+        per (kernel_key, width) and is itself cached."""
+        new_w = cohort_width(need, self._width_hints.get(key))
+        if new_w <= cohort.W:
+            new_w = cohort.W * 2
+        if new_w > self.max_width:
+            return cohort
+        self._width_hints[key] = new_w
+        members = [(s, cohort.members[s])
+                   for s in np.flatnonzero(cohort._occupied)]
+        template = members[0][1] if members else None
+        if template is None:
+            return cohort
+        fresh = Cohort(template, width=new_w)
+        if self.verify is not None:
+            fresh._verify_on = self.verify
+        for new_slot, (old_slot, scn) in enumerate(members):
+            scn.state = cohort.member_state(old_slot)
+            fresh.admit(scn, new_slot)
+        self.cohorts[key] = fresh
+        metrics.inc("ensemble.cohort_grows")
+        return fresh
+
+    def admit(self) -> int:
+        """Drain the queue into cohorts; returns how many scenarios were
+        admitted this pass.  Scenarios whose cohort is full (and at the
+        width cap) stay queued — that backlog IS the queue-depth signal."""
+        admitted = 0
+        if not self._queue:
+            return 0
+        with metrics.phase("ensemble.admit"):
+            # size new (and grown) cohorts by the whole pending backlog
+            # for their key, not one member at a time — a burst of 256
+            # submissions lands in ONE width-256 cohort body instead of
+            # walking the ladder through every intermediate width
+            pending: dict = {}
+            for scn in self._queue:
+                key = self._cohort_id(scn)
+                pending[key] = pending.get(key, 0) + 1
+            still: deque = deque()
+            while self._queue:
+                scn = self._queue.popleft()
+                key = self._cohort_id(scn)
+                cohort = self.cohorts.get(key)
+                if cohort is None:
+                    if (self.max_cohorts is not None
+                            and len(self.cohorts) >= self.max_cohorts):
+                        scn.status = "rejected"
+                        scn.reject_reason = "capacity"
+                        metrics.inc("ensemble.rejected", reason="capacity")
+                        pending[key] -= 1
+                        continue
+                    width = cohort_width(
+                        min(pending.get(key, 1), self.max_width),
+                        self._width_hints.get(key),
+                    )
+                    self._width_hints[key] = width
+                    cohort = Cohort(scn, width=width)
+                    if self.verify is not None:
+                        cohort._verify_on = self.verify
+                    self.cohorts[key] = cohort
+                free = cohort.free_slots()
+                if len(free) == 0:
+                    cohort = self._grow(
+                        key, cohort,
+                        cohort.occupancy + pending.get(key, 1),
+                    )
+                    free = cohort.free_slots()
+                if len(free) == 0:
+                    still.append(scn)     # width cap: stays in backlog
+                    continue
+                cohort.admit(scn, int(free[0]))
+                pending[key] -= 1
+                admitted += 1
+                metrics.inc("ensemble.admitted")
+                metrics.observe("ensemble.queue_latency",
+                                scn.admitted_at - scn.submitted_at)
+            self._queue = still
+        self._update_gauges()
+        return admitted
+
+    def _update_gauges(self) -> None:
+        if not metrics.enabled:
+            return
+        metrics.gauge("ensemble.queue_depth", self.queue_depth())
+        for cohort in self.cohorts.values():
+            metrics.gauge(
+                "ensemble.cohort_occupancy",
+                cohort.occupancy / max(cohort.W, 1),
+                signature=cohort.sig_label,
+            )
+            metrics.gauge(
+                "ensemble.cohort_peak_occupancy",
+                cohort.peak_occupancy,
+                signature=cohort.sig_label,
+            )
+
+    # ---------------------------------------------------------- stepping
+
+    def _ordered_cohorts(self) -> list:
+        live = [c for c in self.cohorts.values() if c.occupancy]
+        if not live:
+            return []
+        if self.policy == "deadline":
+            return sorted(live, key=Cohort.min_deadline)
+        self._rr += 1
+        k = self._rr % len(live)
+        return live[k:] + live[:k]
+
+    def step_once(self) -> int:
+        """One scheduling tick: step every cohort with active members
+        (policy order), then retire finished members.  Returns total
+        member-steps served."""
+        served = 0
+        for cohort in self._ordered_cohorts():
+            served += cohort.step()
+            for slot in cohort.finished_slots():
+                scn = cohort.retire(int(slot))
+                self.completed.append(scn)
+                metrics.inc("ensemble.retired")
+        self._update_gauges()
+        return served
+
+    def run(self, max_ticks: int | None = None) -> int:
+        """Admit + step until every submitted scenario finishes (or
+        ``max_ticks`` scheduling ticks elapse).  Returns total
+        member-steps served."""
+        total = 0
+        ticks = 0
+        while True:
+            self.admit()
+            served = self.step_once()
+            total += served
+            ticks += 1
+            idle = (served == 0 and not self._queue)
+            if idle or (max_ticks is not None and ticks >= max_ticks):
+                return total
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class Ensemble:
+    """User-facing serving front-end over :class:`Scheduler`.
+
+    >>> ens = Ensemble()
+    >>> t = ens.submit(model, state, steps=10, dt=dt, tenant="alice")
+    >>> ens.run()
+    >>> final = t.result          # bit-identical to solo stepping
+
+    ``verify=True`` (or ``DCCRG_ENSEMBLE_VERIFY=1``) arms the
+    solo-replay oracle; ``policy="deadline"`` steps cohorts by earliest
+    member deadline instead of round-robin."""
+
+    def __init__(self, policy: str = "round_robin",
+                 max_width: int | None = None,
+                 max_cohorts: int | None = None,
+                 verify: bool | None = None):
+        self.scheduler = Scheduler(policy=policy, max_width=max_width,
+                                   max_cohorts=max_cohorts, verify=verify)
+
+    def submit(self, model, state, steps: int, dt=None,
+               tenant: str = "default",
+               deadline: float | None = None) -> Scenario:
+        scn = Scenario(model, state, steps, dt=dt, tenant=tenant,
+                       deadline=deadline)
+        return self.scheduler.submit(scn)
+
+    def admit_pending(self) -> int:
+        return self.scheduler.admit()
+
+    def step(self) -> int:
+        return self.scheduler.step_once()
+
+    def run(self, max_ticks: int | None = None) -> int:
+        return self.scheduler.run(max_ticks=max_ticks)
+
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth()
+
+    @property
+    def completed(self) -> list:
+        return self.scheduler.completed
+
+    @property
+    def cohorts(self) -> dict:
+        return self.scheduler.cohorts

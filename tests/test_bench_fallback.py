@@ -30,12 +30,13 @@ def sandbox(tmp_path, monkeypatch):
                         lambda: {"stub": True})
     monkeypatch.setattr(bench, "measure_scalability", lambda: {"stub": True})
     monkeypatch.setattr(bench, "measure_cpu_baseline", lambda: 6.5e7)
-    # the shape-stability churn, halo-overlap and elastic probes spawn
-    # real jax children — stubbed out like the other slow evidence
-    # collectors
+    # the shape-stability churn, halo-overlap, elastic and ensemble
+    # probes spawn real jax children — stubbed out like the other slow
+    # evidence collectors
     monkeypatch.setattr(bench, "_attach_epoch_churn", lambda record: None)
     monkeypatch.setattr(bench, "_attach_halo_overlap", lambda record: None)
     monkeypatch.setattr(bench, "_attach_elastic", lambda record: None)
+    monkeypatch.setattr(bench, "_attach_ensemble", lambda record: None)
     return bench, tmp_path
 
 

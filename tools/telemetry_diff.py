@@ -166,6 +166,16 @@ DEFAULT_ALLOW = (
     # rescale spikes are the MECHANISM working, not a regression)
     "elastic.rescale",
     "supervisor.poll",
+    # ISSUE 9 ensemble phases: admit cost scales with how many scenarios
+    # the round submitted and step cost with the cohort widths it chose
+    # to drive; the verify phase replays solo members on demand — all
+    # workload-shaped.  The regression the gate DOES watch is the
+    # cohort-occupancy floor (GATED_GAUGES_MIN) and the recompile
+    # counter: a serving round that starts retracing or fragmenting its
+    # cohorts fails there, not on wall time.
+    "ensemble.admit",
+    "ensemble.step",
+    "ensemble.verify",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
@@ -180,6 +190,14 @@ DEFAULT_ALLOW = (
 #: them.
 GATED_GAUGES_MIN = (
     "overlap.fraction",
+    # ISSUE 9: highest occupied fraction each cohort reached (labeled by
+    # the cross-process-stable signature).  A DROP means admissions
+    # stopped packing scenarios into shared executables — cohort
+    # fragmentation, exactly the regression ensemble serving exists to
+    # prevent.  Monotone per round by construction (a peak), so the
+    # floor is meaningful where live occupancy (which legitimately
+    # returns to 0 after retirement) would be noise.
+    "ensemble.cohort_peak_occupancy",
 )
 
 
