@@ -1253,6 +1253,32 @@ def _attach_ensemble(record: dict) -> None:
         print(f"ensemble probe failed: {e}", file=sys.stderr)
 
 
+def _slo_summary(report: dict) -> dict:
+    """Latency quantiles + deadline-miss rates out of one exported
+    telemetry report (ISSUE 10), via the stdlib-only ``obs/slo.py``
+    loaded from its file — the bench parent never imports jax."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "dccrg_slo", str(ROOT / "dccrg_tpu" / "obs" / "slo.py"))
+        slo = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(slo)
+        latency = {}
+        for name in slo.LATENCY_HISTOGRAMS:
+            series = slo.collect_series(report, name)
+            if series:
+                latency[name] = {label: slo.summarize(h)
+                                 for label, h in sorted(series.items())}
+        return {
+            "latency": latency,
+            "deadline_miss_rates": slo.deadline_miss_rates(report),
+        }
+    except Exception as e:  # noqa: BLE001 - telemetry never kills the bench
+        print(f"slo summary failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _attach_telemetry(record: dict) -> None:
     """Fold telemetry.json's phase breakdown into the bench record so
     BENCH_*.json rounds carry where epoch/halo/LB/AMR/checkpoint time
@@ -1269,6 +1295,10 @@ def _attach_telemetry(record: dict) -> None:
             "file": "telemetry.json",
             "workload": t.get("workload"),
             "phases": phases,
+            # the round's latency distributions ride along verbatim so
+            # tools/slo_report.py (and the diff gate's p99 ceiling) can
+            # quantile a bench record directly, no live process needed
+            "histograms": t.get("histograms", {}),
             "halo_bytes_moved": counters.get(
                 "halo.bytes_moved", {}).get(""),
             "halo_wire_bytes": counters.get(
@@ -1326,6 +1356,12 @@ def _attach_telemetry(record: dict) -> None:
                     else None
                 ),
             },
+            # ISSUE 10: the request-level SLO plane — per-tenant/model
+            # latency quantiles recovered from the round's exported
+            # log-bucket histograms plus deadline-miss accounting, so
+            # BENCH rounds carry "were users served in time", not just
+            # how fast cohorts stepped
+            "slo": _slo_summary(t),
         }
     except (OSError, ValueError) as e:
         print(f"could not attach telemetry.json: {e}", file=sys.stderr)

@@ -39,6 +39,19 @@ seam instead:
   same labels ``epoch.recompiles`` counts.  ``DCCRG_XPLANE=0`` opts
   out; deviceless captures degrade to a documented no-op.
 
+* the request-level SLO plane (ISSUE 10): ``obs.slo`` — post-hoc
+  quantiles (``p50/p95/p99``) and cross-process merges over the
+  exported log-bucketed histograms (the serving front-end records
+  ``ensemble.queue_wait_s{tenant}`` / ``ensemble.service_s`` /
+  ``ensemble.e2e_s`` per request, and every completed phase feeds
+  ``phase.duration_s{phase=...}`` via the registry's
+  ``observe_duration`` hook; ``DCCRG_PHASE_HIST=0`` opts out) — plus
+  the ``obs.flightrec`` black box: an always-on bounded ring of recent
+  spans/events/in-flight requests, dumped as a schema-valid postmortem
+  on supervisor escalation, oracle mismatch, or demand
+  (``DCCRG_FLIGHTREC``, ``DCCRG_FLIGHTREC_DIR``,
+  ``DCCRG_FLIGHTREC_CAP``; ``tools/slo_report.py`` is the read side).
+
 Telemetry is on by default (the recording sites are per-epoch or
 per-host-dispatch, never inside device loops); ``disable()`` — or
 ``DCCRG_TELEMETRY=0`` in the environment — makes every recording call a
@@ -59,7 +72,13 @@ from .events import (
 )
 from .hbm import sample_hbm
 from . import fused
+from . import slo
 from . import xplane
+from .flightrec import (
+    FlightRecorder,
+    recorder as flight_recorder,
+    validate_flightrec,
+)
 from .merge import (
     ClockAlignment,
     MergedTrace,
@@ -88,7 +107,11 @@ __all__ = [
     "disable_timeline",
     "sample_hbm",
     "fused",
+    "slo",
     "xplane",
+    "FlightRecorder",
+    "flight_recorder",
+    "validate_flightrec",
     "ClockAlignment",
     "MergedTrace",
     "build_merged",

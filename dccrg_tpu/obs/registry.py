@@ -76,6 +76,22 @@ class MetricsRegistry:
         #: (attached by ``obs.events`` for the process-wide registry;
         #: stays None for isolated test registries unless set)
         self.timeline = None
+        #: optional flight recorder fed every completed phase span
+        #: (attached by ``obs.flightrec`` for the process-wide registry —
+        #: the always-on black box of ISSUE 10)
+        self.recorder = None
+        #: when truthy, every completed phase span ALSO lands in the
+        #: ``phase.duration_s{phase=<name>}`` histogram via
+        #: :meth:`observe_duration` — per-span latency distributions
+        #: (quantiles via ``obs.slo``) without touching any call site.
+        #: ``DCCRG_PHASE_HIST=0`` starts it off.
+        self.duration_histograms = _phase_hist_default()
+        #: per-histogram log-bucket resolution: buckets per octave
+        #: (default 1 — the original power-of-two buckets).  The SLO
+        #: plane registers its latency series at a finer grain so p99
+        #: estimates resolve below the factor-2 default
+        #: (:meth:`set_histogram_resolution`).
+        self._hist_res: dict = {}
 
     # ------------------------------------------------------------- writes
 
@@ -142,10 +158,21 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[key] = value
 
+    def set_histogram_resolution(self, name: str, per_octave: int) -> None:
+        """Refine one histogram's log buckets to ``per_octave`` buckets
+        per factor of two (upper edges ``2^(k/per_octave)``).  Applies to
+        samples observed AFTER the call; exported bucket keys stay upper
+        edges, so ``obs.slo`` merge/quantile consume either resolution.
+        Register the same resolution in every process whose exports will
+        be merged (bucket keys must coincide)."""
+        self._hist_res[str(name)] = max(int(per_octave), 1)
+
     def observe(self, name: str, value, **labels) -> None:
         """Record a sample into a histogram (count/sum/min/max plus
-        power-of-two buckets: a sample lands in the smallest ``le=2^e``
-        bucket holding it; non-positive samples land in ``le=0``)."""
+        log buckets: a sample lands in the smallest ``le=2^(k/R)``
+        bucket holding it, where ``R`` is the histogram's registered
+        resolution — default 1, the power-of-two buckets; non-positive
+        samples land in ``le=0``)."""
         if not self.enabled:
             return
         key = (name, _labels_key(labels))
@@ -158,6 +185,17 @@ class MetricsRegistry:
             m, exp = math.frexp(value)
             if m == 0.5:
                 exp -= 1
+            res = self._hist_res.get(name)
+            if res is not None and res > 1:
+                # smallest k with 2^(k/res) >= value, edge-exclusive
+                # below: samples sitting exactly on an edge stay in
+                # that edge's bucket (le semantics, like the octaves)
+                k = math.ceil(math.log2(value) * res)
+                while 2.0 ** (k / res) < value:      # fp guard
+                    k += 1
+                while 2.0 ** ((k - 1) / res) >= value:
+                    k -= 1
+                exp = k / res
         with self._lock:
             h = self._hists.get(key)
             if h is None:
@@ -182,9 +220,29 @@ class MetricsRegistry:
             else:
                 rec[0] += dt
                 rec[1] += 1
+        self._span_hooks(name, time.perf_counter() - dt, dt)
+
+    def observe_duration(self, name: str, dt: float) -> None:
+        """Phase-hook (ISSUE 10): record one completed phase span into
+        the ``phase.duration_s{phase=<name>}`` histogram, so every
+        existing phase timer feeds the latency-quantile plane
+        (``obs.slo``) without new call sites.  Fired from :meth:`phase`
+        / :meth:`phase_add` while :attr:`duration_histograms` is on;
+        callable directly for spans timed outside the registry."""
+        self.observe("phase.duration_s", dt, phase=name)
+
+    def _span_hooks(self, name: str, begin: float, dt: float) -> None:
+        """Everything a completed phase span feeds beyond the aggregate
+        phase table: the event timeline, the per-phase duration
+        histogram, and the flight recorder's ring."""
         tl = self.timeline
         if tl is not None and tl.enabled:
-            tl.add(name, time.perf_counter() - dt, dt)
+            tl.add(name, begin, dt)
+        if self.duration_histograms:
+            self.observe_duration(name, dt)
+        fr = self.recorder
+        if fr is not None and fr.enabled:
+            fr.add_span(name, begin, dt)
 
     @contextmanager
     def phase(self, name: str):
@@ -225,9 +283,7 @@ class MetricsRegistry:
                     else:
                         rec[0] += dt
                         rec[1] += 1
-                tl = self.timeline
-                if tl is not None and tl.enabled:
-                    tl.add(name, t0, dt)
+                self._span_hooks(name, t0, dt)
             else:
                 depths[name] = outer
 
@@ -309,6 +365,12 @@ class MetricsRegistry:
 
 def _default_enabled() -> bool:
     return os.environ.get("DCCRG_TELEMETRY", "1").lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def _phase_hist_default() -> bool:
+    return os.environ.get("DCCRG_PHASE_HIST", "1").lower() not in (
         "0", "false", "off", "no",
     )
 

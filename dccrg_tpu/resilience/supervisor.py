@@ -31,6 +31,7 @@ import json
 import os
 import time
 
+from ..obs.flightrec import recorder as flightrec
 from ..obs.registry import metrics
 
 __all__ = ["HeartbeatMonitor", "EscalationLadder", "Supervisor"]
@@ -131,6 +132,14 @@ class EscalationLadder:
     ``supervisor.escalations{action}``, and the degraded rung
     additionally under ``elastic.degraded`` (a rescale the fleet was
     forced into, as opposed to one the policy chose).
+
+    The first rung of an incident also triggers the flight recorder
+    (ISSUE 10): ONE schema-valid postmortem dump per incident — the
+    evidence window that is otherwise gone by the time the driver kills
+    and relaunches the worker.  A healthy ``reset()`` re-arms the dump
+    for the next incident; when no dump directory is configured
+    (``DCCRG_FLIGHTREC_DIR`` unset, recorder unarmed) the trigger is a
+    counted no-op.
     """
 
     ACTIONS = ("warn", "rescale_down", "restart")
@@ -139,6 +148,10 @@ class EscalationLadder:
         self.patience = max(int(patience), 1)
         self._level = 0
         self._strikes = 0
+        self._dumped = False
+        #: path of the incident's postmortem (None until the ladder
+        #: fires, or when the recorder is unarmed/disabled)
+        self.last_dump = None
 
     @property
     def level(self) -> int:
@@ -153,6 +166,14 @@ class EscalationLadder:
             self._level, self._strikes = floor, 0
         action = self.ACTIONS[self.level]
         self._strikes += 1
+        if not self._dumped:
+            # black-box the incident ONCE, at its first rung — by the
+            # restart rung the worker (and its evidence) is gone
+            self._dumped = True
+            flightrec.note("supervisor.escalation", reason=reason,
+                           action=action)
+            self.last_dump = flightrec.dump(
+                reason=f"escalation:{reason}:{action}")
         if self._strikes >= self.patience:
             self._level = min(self._level + 1, len(self.ACTIONS))
             self._strikes = 0
@@ -167,6 +188,7 @@ class EscalationLadder:
     def reset(self) -> None:
         self._level = 0
         self._strikes = 0
+        self._dumped = False
 
 
 class Supervisor:

@@ -45,6 +45,26 @@ fleet.  This module is the front-end that exploits it:
   (submit → admit seconds), and the ``ensemble.admit`` /
   ``ensemble.step`` phases.
 
+* **Request-level SLO plane** (ISSUE 10): every scenario carries a
+  request id and its lifecycle is recorded three ways — latency
+  histograms ``ensemble.queue_wait_s{tenant}`` (submit → admit),
+  ``ensemble.service_s{tenant, model}`` (admit → retire) and
+  ``ensemble.e2e_s{tenant}`` (submit → retire), all log-bucketed at
+  ``obs.slo.SLO_RESOLUTION`` so exported snapshots answer p50/p95/p99
+  post-hoc (``tools/slo_report.py``); timeline spans
+  ``request.queued`` / ``request.admit`` / ``request.step`` /
+  ``request.retire`` / ``request.e2e`` carrying ``request=<id>``
+  context args, so a slow request cross-references to kernel spans in
+  the merged device trace; and the ``obs.flightrec`` black box, whose
+  in-flight table names exactly the requests being served when a
+  postmortem fires.  Deadlines are absolute ``time.perf_counter()``
+  stamps (the timebase of ``submitted_at``); a member retired past its
+  deadline counts ``ensemble.deadline_miss{tenant}`` and
+  ``ensemble.slo_violations{class=deadline}`` — misses are COUNTED,
+  never raised, like every oracle in this repo.  Optional targets
+  ``DCCRG_SLO_QUEUE_S`` / ``DCCRG_SLO_E2E_S`` (seconds) count
+  ``ensemble.slo_violations{class=queue_wait|e2e}`` when exceeded.
+
 Correctness anchor: a cohort-stepped scenario is **bit-identical** to
 the same member stepped solo through its own model kernel (vmap batches
 the member program without reassociating its arithmetic).  The
@@ -63,9 +83,19 @@ from collections import deque
 
 import numpy as np
 
+from ..obs.events import timeline
+from ..obs.flightrec import recorder as flightrec
 from ..obs.registry import metrics
+from ..obs.slo import SLO_RESOLUTION
 from ..parallel.exec_cache import BatchStepSpec, cohort_key, traced_jit
 from ..parallel.mesh import SHARD_AXIS
+
+# the request-latency series resolve finer than the octave default so
+# exported p99 estimates sit within one ~9% bucket (obs/slo.py); same
+# registration in every serving process keeps cross-process merges exact
+for _h in ("ensemble.queue_wait_s", "ensemble.service_s",
+           "ensemble.e2e_s", "ensemble.queue_latency"):
+    metrics.set_histogram_resolution(_h, SLO_RESOLUTION)
 
 __all__ = [
     "Scenario",
@@ -81,6 +111,18 @@ def verify_enabled() -> bool:
     """Whether the solo-replay oracle is armed process-wide
     (``DCCRG_ENSEMBLE_VERIFY=1``)."""
     return os.environ.get("DCCRG_ENSEMBLE_VERIFY", "0") == "1"
+
+
+def _slo_target(name: str) -> float | None:
+    """Optional SLO target in seconds (``DCCRG_SLO_QUEUE_S`` /
+    ``DCCRG_SLO_E2E_S``); None when unset or unparsable."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
 
 
 def _shrink() -> float:
@@ -118,7 +160,11 @@ class Scenario:
 
     Lifecycle: ``queued`` → ``active`` → ``done`` (``result`` holds the
     final state pytree), or ``rejected`` (``reject_reason`` says why —
-    counted, never raised)."""
+    counted, never raised).  ``id`` is the request id every lifecycle
+    span, histogram sample and flight-recorder entry is stamped with;
+    ``submitted_at``/``admitted_at``/``retired_at`` are
+    ``time.perf_counter()`` stamps (``deadline`` lives in the same
+    timebase) — the raw material of the SLO plane."""
 
     _ids = itertools.count()
 
@@ -137,6 +183,7 @@ class Scenario:
         self.result = None
         self.submitted_at = time.perf_counter()
         self.admitted_at = None
+        self.retired_at = None
         #: filled at submit: the member program + per-member tables
         self.spec: BatchStepSpec | None = None
         self.signature = None
@@ -290,7 +337,10 @@ class Cohort:
             set_slot, self._state, scenario.state
         )
         scenario.status = "active"
-        scenario.admitted_at = time.perf_counter()
+        if scenario.admitted_at is None:
+            # growth re-lands members through admit(); their first
+            # admission stamp is the one queue-wait accounting uses
+            scenario.admitted_at = time.perf_counter()
         self.peak_occupancy = max(self.peak_occupancy,
                                   self.occupancy / max(self.W, 1))
 
@@ -308,6 +358,7 @@ class Cohort:
         scn = self.members[slot]
         scn.result = self.member_state(slot)
         scn.status = "done"
+        scn.retired_at = time.perf_counter()
         self.members[slot] = None
         self._occupied[slot] = False
         self._remaining[slot] = 0
@@ -340,8 +391,24 @@ class Cohort:
         pre = self._state if self._verify_active() else None
         dts = jnp.asarray(self._dts)
         mdev = jnp.asarray(mask)
-        with metrics.phase("ensemble.step"):
-            self._state = self._kernel(self._args, self._state, dts, mdev)
+        t0 = time.perf_counter()
+        # the cohort context rides every span the dispatch completes, so
+        # a trace attributes each ensemble.step to its cohort; the
+        # request.step span names the member requests this dispatch
+        # served (truncated — one span per DISPATCH, not per member)
+        with timeline.context(cohort=self.sig_label, width=self.W):
+            with metrics.phase("ensemble.step"):
+                self._state = self._kernel(self._args, self._state,
+                                           dts, mdev)
+        if timeline.enabled or flightrec.enabled:
+            dt_span = time.perf_counter() - t0
+            args = {
+                "cohort": self.sig_label, "members": n,
+                "requests": [self.members[s].id
+                             for s in np.flatnonzero(mask)[:8]],
+            }
+            timeline.add("request.step", t0, dt_span, args)
+            flightrec.add_span("request.step", t0, dt_span, args)
         self._remaining[mask] -= 1
         if metrics.enabled:
             served: dict = {}
@@ -398,6 +465,18 @@ class Cohort:
                 metrics.inc("ensemble.verify_mismatches", **labels)
         metrics.inc("ensemble.verify_checks", len(solo_l))
         metrics.phase_add("ensemble.verify", time.perf_counter() - t0)
+        if mismatches and not getattr(self, "_fr_dumped", False):
+            # a broken bit-identity anchor is black-box material: one
+            # postmortem per cohort (not per step — mismatch storms
+            # must not turn into dump storms), naming the audited
+            # request and the in-flight cohort members
+            self._fr_dumped = True
+            flightrec.note("ensemble.verify_mismatch",
+                           cohort=self.sig_label,
+                           request=self.members[slot].id
+                           if self.members[slot] is not None else None,
+                           fields=mismatches)
+            flightrec.dump(reason="ensemble.verify_mismatch")
         return mismatches
 
 
@@ -453,9 +532,19 @@ class Scheduler:
             scenario.status = "rejected"
             scenario.reject_reason = reason
             metrics.inc("ensemble.rejected", reason=reason)
+            flightrec.note("request.rejected", request=scenario.id,
+                           tenant=scenario.tenant, reason=reason)
             return scenario
         self._queue.append(scenario)
         metrics.gauge("ensemble.queue_depth", self.queue_depth())
+        # the black box tracks the request from the moment it exists:
+        # a postmortem names queued victims too, not just active ones
+        flightrec.begin_request(scenario.id, tenant=scenario.tenant,
+                                status="queued", steps=scenario.steps,
+                                model=scenario.spec.kind,
+                                deadline=scenario.deadline)
+        flightrec.note("request.queued", request=scenario.id,
+                       tenant=scenario.tenant)
         return scenario
 
     def queue_depth(self) -> int:
@@ -543,12 +632,42 @@ class Scheduler:
                 if len(free) == 0:
                     still.append(scn)     # width cap: stays in backlog
                     continue
+                t_admit = time.perf_counter()
                 cohort.admit(scn, int(free[0]))
                 pending[key] -= 1
                 admitted += 1
                 metrics.inc("ensemble.admitted")
-                metrics.observe("ensemble.queue_latency",
-                                scn.admitted_at - scn.submitted_at)
+                # queue wait from the already-stamped submit/admit pair
+                # (ISSUE 10): the per-tenant histogram the SLO report
+                # quantiles, plus the lifecycle spans — request.queued
+                # covers the whole wait retroactively (both stamps are
+                # perf_counter, the timeline's native timebase)
+                wait = scn.admitted_at - scn.submitted_at
+                metrics.observe("ensemble.queue_latency", wait)
+                metrics.observe("ensemble.queue_wait_s", wait,
+                                tenant=scn.tenant)
+                target = _slo_target("DCCRG_SLO_QUEUE_S")
+                if target is not None and wait > target:
+                    metrics.inc("ensemble.slo_violations",
+                                **{"class": "queue_wait"})
+                if timeline.enabled or flightrec.enabled:
+                    args = {"request": scn.id, "tenant": scn.tenant}
+                    timeline.add("request.queued", scn.submitted_at,
+                                 wait, args)
+                    done = time.perf_counter()
+                    timeline.add("request.admit", t_admit,
+                                 done - t_admit, args)
+                    flightrec.add_span("request.queued",
+                                       scn.submitted_at, wait, args)
+                flightrec.begin_request(scn.id, tenant=scn.tenant,
+                                        status="active",
+                                        model=scn.spec.kind,
+                                        cohort=cohort.sig_label,
+                                        deadline=scn.deadline)
+                flightrec.note("request.admit", request=scn.id,
+                               tenant=scn.tenant,
+                               cohort=cohort.sig_label,
+                               queue_wait_s=round(wait, 6))
             self._queue = still
         self._update_gauges()
         return admitted
@@ -592,8 +711,44 @@ class Scheduler:
                 scn = cohort.retire(int(slot))
                 self.completed.append(scn)
                 metrics.inc("ensemble.retired")
+                self._account_retirement(scn, cohort)
         self._update_gauges()
         return served
+
+    def _account_retirement(self, scn: Scenario, cohort: Cohort) -> None:
+        """Request-level SLO accounting at retirement (ISSUE 10):
+        service/e2e latency histograms, deadline-miss counting (misses
+        are counted, never raised — deadlines only affected scheduling
+        order before), the closing lifecycle spans, and the flight
+        recorder's in-flight table."""
+        if not (metrics.enabled or flightrec.enabled):
+            return
+        service = scn.retired_at - scn.admitted_at
+        e2e = scn.retired_at - scn.submitted_at
+        missed = (scn.deadline is not None
+                  and scn.retired_at > scn.deadline)
+        metrics.observe("ensemble.service_s", service,
+                        tenant=scn.tenant, model=cohort.spec.kind)
+        metrics.observe("ensemble.e2e_s", e2e, tenant=scn.tenant)
+        if missed:
+            metrics.inc("ensemble.deadline_miss", tenant=scn.tenant)
+            metrics.inc("ensemble.slo_violations",
+                        **{"class": "deadline"})
+        target = _slo_target("DCCRG_SLO_E2E_S")
+        if target is not None and e2e > target:
+            metrics.inc("ensemble.slo_violations", **{"class": "e2e"})
+        if timeline.enabled or flightrec.enabled:
+            args = {"request": scn.id, "tenant": scn.tenant,
+                    "model": cohort.spec.kind, "steps": scn.steps_done,
+                    "deadline_missed": bool(missed)}
+            timeline.add("request.retire", scn.retired_at, 0.0, args)
+            timeline.add("request.e2e", scn.submitted_at, e2e, args)
+            flightrec.add_span("request.e2e", scn.submitted_at, e2e,
+                               args)
+        flightrec.end_request(scn.id, tenant=scn.tenant,
+                              status="done", steps=scn.steps_done,
+                              e2e_s=round(e2e, 6),
+                              deadline_missed=bool(missed))
 
     def run(self, max_ticks: int | None = None) -> int:
         """Admit + step until every submitted scenario finishes (or

@@ -1,0 +1,517 @@
+"""The request-level SLO plane (ISSUE 10): quantile estimation over
+exported log-bucket histograms, cross-registry/-process merges, request
+lifecycle spans and deadline accounting in the serving front-end, the
+flight-recorder black box, and the telemetry_diff p99 ceiling gate."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.obs import slo
+from dccrg_tpu.obs.flightrec import (
+    FlightRecorder,
+    recorder as flight_recorder,
+    validate_flightrec,
+)
+from dccrg_tpu.obs.registry import MetricsRegistry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+# ------------------------------------------------------------ quantiles
+
+
+def test_quantile_single_value_is_exact():
+    reg = MetricsRegistry()
+    for _ in range(10):
+        reg.observe("lat", 0.125)
+    h = reg.report()["histograms"]["lat"][""]
+    for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert slo.quantile(h, q) == pytest.approx(0.125)
+
+
+def test_quantile_known_values_fine_resolution():
+    """At the SLO resolution (8 buckets/octave, ~9% edges) quantile
+    estimates of a smooth sample set sit within one bucket of truth."""
+    reg = MetricsRegistry()
+    reg.set_histogram_resolution("lat", slo.SLO_RESOLUTION)
+    rng = np.random.default_rng(0)
+    vals = np.sort(rng.lognormal(-3.0, 1.0, size=4000))
+    for v in vals:
+        reg.observe("lat", float(v))
+    h = reg.report()["histograms"]["lat"][""]
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = slo.quantile(h, q)
+        true = float(vals[int(q * (len(vals) - 1))])
+        assert est == pytest.approx(true, rel=2.0 ** (1 / 8) - 1 + 0.02)
+
+
+def test_quantile_ordering_and_envelope():
+    reg = MetricsRegistry()
+    for v in (0.001, 0.002, 0.01, 0.05, 0.9, 3.0):
+        reg.observe("lat", v)
+    h = reg.report()["histograms"]["lat"][""]
+    p50, p95, p99 = (slo.quantile(h, q) for q in (0.5, 0.95, 0.99))
+    assert p50 <= p95 <= p99
+    assert h["min"] <= p50 and p99 <= h["max"]
+
+
+def test_quantile_empty_and_json_roundtrip():
+    assert slo.quantile({}, 0.5) is None
+    assert slo.quantile({"count": 0}, 0.5) is None
+    reg = MetricsRegistry()
+    reg.observe("lat", 0.25)
+    reg.observe("lat", 1.0)
+    # the post-hoc path: through JSON exactly as telemetry.json stores it
+    h = json.loads(json.dumps(reg.report()))["histograms"]["lat"][""]
+    assert 0.25 <= slo.quantile(h, 0.5) <= 1.0
+
+
+def test_merge_equals_pooled_observation():
+    """Merging two registries' exports is EXACT: same result as one
+    registry observing the pooled samples (equal values -> equal bucket
+    keys on both sides)."""
+    a, b, pooled = (MetricsRegistry() for _ in range(3))
+    for r in (a, b, pooled):
+        r.set_histogram_resolution("lat", slo.SLO_RESOLUTION)
+    rng = np.random.default_rng(1)
+    for i, v in enumerate(rng.lognormal(-2, 0.7, size=300)):
+        (a if i % 2 else b).observe("lat", float(v))
+        pooled.observe("lat", float(v))
+    ha = a.report()["histograms"]["lat"][""]
+    hb = b.report()["histograms"]["lat"][""]
+    hp = pooled.report()["histograms"]["lat"][""]
+    m = slo.merge(ha, hb)
+    assert m["count"] == hp["count"]
+    assert m["buckets"] == hp["buckets"]
+    assert m["min"] == hp["min"] and m["max"] == hp["max"]
+    assert slo.quantile(m, 0.99) == pytest.approx(
+        slo.quantile(hp, 0.99))
+
+
+def test_merge_across_processes():
+    """The cross-process form: a subprocess exports its registry as
+    JSON (registry.py file-loaded — no package, no jax), merged here
+    label by label via merge_series."""
+    code = (
+        "import importlib.util, json, sys\n"
+        "spec = importlib.util.spec_from_file_location('reg', %r)\n"
+        "reg = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(reg)\n"
+        "r = reg.MetricsRegistry()\n"
+        "r.set_histogram_resolution('ensemble.e2e_s', %d)\n"
+        "for i in range(50):\n"
+        "    r.observe('ensemble.e2e_s', 0.01 * (i + 1), tenant='a')\n"
+        "print(json.dumps(r.report()))\n"
+        % (os.path.join(ROOT, "dccrg_tpu", "obs", "registry.py"),
+           slo.SLO_RESOLUTION)
+    )
+    out = subprocess.run([sys.executable, "-c", code], check=True,
+                         capture_output=True, text=True)
+    remote = json.loads(out.stdout.strip().splitlines()[-1])
+    local = MetricsRegistry()
+    local.set_histogram_resolution("ensemble.e2e_s", slo.SLO_RESOLUTION)
+    for i in range(50):
+        local.observe("ensemble.e2e_s", 0.01 * (i + 1), tenant="a")
+    merged = slo.merge_series([remote, local.report()], "ensemble.e2e_s")
+    assert merged["tenant=a"]["count"] == 100
+    # identical sample sets in both processes: the merged quantile is
+    # the single-process quantile
+    solo = slo.quantile(local.report()["histograms"]
+                        ["ensemble.e2e_s"]["tenant=a"], 0.95)
+    assert slo.quantile(merged["tenant=a"], 0.95) == pytest.approx(solo)
+
+
+def test_observe_duration_phase_hook():
+    """Existing phase timers feed the histogram plane with no new call
+    sites; DCCRG_PHASE_HIST=0 (per-registry flag) opts out."""
+    reg = MetricsRegistry()
+    assert reg.duration_histograms  # default on
+    with reg.phase("work"):
+        pass
+    reg.phase_add("hot", 0.002)
+    hists = reg.report()["histograms"]["phase.duration_s"]
+    assert hists["phase=work"]["count"] == 1
+    assert hists["phase=hot"]["count"] == 1
+
+    off = MetricsRegistry()
+    off.duration_histograms = False
+    with off.phase("work"):
+        pass
+    assert "phase.duration_s" not in off.report()["histograms"]
+
+
+def test_phase_hist_env_gate():
+    code = (
+        "import importlib.util, os\n"
+        "os.environ['DCCRG_PHASE_HIST'] = '0'\n"
+        "spec = importlib.util.spec_from_file_location('reg', %r)\n"
+        "reg = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(reg)\n"
+        "assert not reg.MetricsRegistry().duration_histograms\n"
+        "print('GATED-OK')\n"
+        % os.path.join(ROOT, "dccrg_tpu", "obs", "registry.py")
+    )
+    out = subprocess.run([sys.executable, "-c", code], check=True,
+                         capture_output=True, text=True)
+    assert "GATED-OK" in out.stdout
+
+
+# ----------------------------------------------------- serving lifecycle
+
+
+def _gol_ensemble():
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.serve import Ensemble
+
+    n = 4
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=(1.0 / n,) * 3)
+        .initialize(mesh=make_mesh())
+    )
+    g.stop_refining()
+    gol = GameOfLife(g, allow_dense=False)
+    cells = g.get_cells()
+    rng = np.random.default_rng(7)
+    mk = lambda: gol.new_state(
+        alive_cells=cells[rng.random(len(cells)) < 0.3]
+    )
+    return Ensemble(), gol, mk
+
+
+def test_request_lifecycle_spans_and_histograms():
+    obs.metrics.reset()
+    obs.timeline.clear()
+    ens, gol, mk = _gol_ensemble()
+    t = ens.submit(gol, mk(), steps=3, tenant="alice")
+    ens.submit(gol, mk(), steps=2, tenant="bob")
+    ens.run()
+    assert t.status == "done"
+    assert t.retired_at is not None
+    assert t.retired_at >= t.admitted_at >= t.submitted_at
+
+    rep = obs.metrics.report()
+    hists = rep["histograms"]
+    assert hists["ensemble.queue_wait_s"]["tenant=alice"]["count"] == 1
+    assert hists["ensemble.e2e_s"]["tenant=bob"]["count"] == 1
+    svc = hists["ensemble.service_s"]
+    assert any("tenant=alice" in label and "model=" in label
+               for label in svc)
+
+    spans = obs.timeline.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    for wanted in ("request.queued", "request.admit", "request.step",
+                   "request.retire", "request.e2e"):
+        assert wanted in by_name, f"missing lifecycle span {wanted}"
+    # the e2e span carries the request id and covers submit -> retire
+    e2e = [s for s in by_name["request.e2e"]
+           if s["args"] and s["args"].get("request") == t.id]
+    assert len(e2e) == 1
+    assert e2e[0]["begin"] == pytest.approx(t.submitted_at)
+    assert e2e[0]["dur"] == pytest.approx(t.retired_at - t.submitted_at)
+    # step spans name their member requests
+    assert any(t.id in (s["args"] or {}).get("requests", [])
+               for s in by_name["request.step"])
+
+
+def test_deadline_miss_counted_at_retire():
+    obs.metrics.reset()
+    ens, gol, mk = _gol_ensemble()
+    now = time.perf_counter()
+    ens.submit(gol, mk(), steps=2, tenant="late", deadline=now - 5.0)
+    ens.submit(gol, mk(), steps=2, tenant="fine", deadline=now + 3600.0)
+    ens.submit(gol, mk(), steps=2, tenant="none")
+    ens.run()
+    counters = obs.metrics.report()["counters"]
+    assert counters["ensemble.deadline_miss"] == {"tenant=late": 1}
+    assert counters["ensemble.slo_violations"] == {"class=deadline": 1}
+    rates = slo.deadline_miss_rates(obs.metrics.report())
+    assert rates["late"] == {"missed": 1, "completed": 1, "rate": 1.0}
+    assert rates["fine"]["missed"] == 0
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flightrec_ring_bound():
+    fr = FlightRecorder(cap=16, enabled=True)
+    for i in range(50):
+        fr.add_span(f"s{i}", float(i), 0.001)
+        fr.note("tick", i=i)
+    rec = fr.record()
+    assert len(rec["spans"]) == 16
+    assert len(rec["events"]) == 16
+    assert rec["dropped"] == {"spans": 34, "events": 34}
+    # the ring keeps the NEWEST spans — the postmortem window
+    assert rec["spans"][-1]["name"] == "s49"
+    assert rec["spans"][0]["name"] == "s34"
+
+
+def test_flightrec_cap_env(monkeypatch):
+    monkeypatch.setenv("DCCRG_FLIGHTREC_CAP", "32")
+    assert FlightRecorder().cap == 32
+    monkeypatch.setenv("DCCRG_FLIGHTREC_CAP", "bogus")
+    assert FlightRecorder().cap == 512
+
+
+def test_flightrec_env_disable(monkeypatch, tmp_path):
+    monkeypatch.setenv("DCCRG_FLIGHTREC", "0")
+    fr = FlightRecorder()
+    assert not fr.enabled
+    fr.add_span("s", 0.0, 1.0)
+    fr.note("k")
+    fr.begin_request("r")
+    assert len(fr) == 0 and fr.in_flight() == []
+    assert fr.dump(path=str(tmp_path / "d.json")) is None
+    assert not (tmp_path / "d.json").exists()
+
+
+def test_flightrec_dump_schema_and_inflight(tmp_path):
+    fr = FlightRecorder(cap=64, enabled=True)
+    fr.add_span("halo.exchange", time.perf_counter(), 0.004,
+                {"ring": 1})
+    fr.begin_request(17, tenant="alice", status="active")
+    fr.note("request.admit", request=17)
+    path = fr.dump(path=str(tmp_path / "pm.json"), reason="unit-test")
+    assert validate_flightrec(path) == []
+    rec = json.loads((tmp_path / "pm.json").read_text())
+    assert rec["schema"] == "dccrg.flightrec.v1"
+    assert rec["reason"] == "unit-test"
+    assert [r["id"] for r in rec["in_flight"]] == ["17"]
+    assert rec["snapshot"].keys() >= {"phases", "counters", "gauges",
+                                      "histograms"}
+    # tampering is detected
+    rec["spans"] = [{"name": 3}]
+    (tmp_path / "pm.json").write_text(json.dumps(rec))
+    assert validate_flightrec(str(tmp_path / "pm.json"))
+
+
+def test_flightrec_unarmed_dump_is_noop():
+    fr = FlightRecorder(enabled=True)
+    assert fr.dump(reason="nowhere") is None
+
+
+def test_flightrec_mark_unit_tracks_one(tmp_path):
+    fr = FlightRecorder(enabled=True)
+    fr.arm(str(tmp_path), period=1000.0)  # no autodump interference
+    fr.mark_unit("gol/0", phase="gol", step=0)
+    fr.mark_unit("gol/1", phase="gol", step=1)
+    assert [r["id"] for r in fr.in_flight()] == ["gol/1"]
+    fr.disarm()
+
+
+def test_flightrec_checkpoint_atomic_and_named(tmp_path):
+    fr = FlightRecorder(enabled=True)
+    fr.arm(str(tmp_path), period=0.0, autodump=True)
+    fr.mark_unit("adv/3", phase="adv", step=3)
+    files = [p for p in os.listdir(tmp_path)
+             if p.startswith("flightrec_") and p.endswith(".json")]
+    assert files, "autodump checkpoint never landed"
+    newest = os.path.join(tmp_path, files[0])
+    assert validate_flightrec(newest) == []
+    rec = json.loads(open(newest).read())
+    assert [r["id"] for r in rec["in_flight"]] == ["adv/3"]
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+    fr.disarm()
+
+
+def test_escalation_dumps_once_per_incident(tmp_path):
+    from dccrg_tpu.resilience import EscalationLadder
+
+    prev = flight_recorder.armed_dir
+    try:
+        flight_recorder.arm(str(tmp_path), autodump=False)
+        ladder = EscalationLadder()
+        actions = [ladder.escalate("stall") for _ in range(3)]
+        assert actions == ["warn", "rescale_down", "restart"]
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flightrec_")]
+        assert len(dumps) == 1, dumps
+        assert validate_flightrec(os.path.join(tmp_path, dumps[0])) == []
+        assert ladder.last_dump == os.path.join(tmp_path, dumps[0])
+        rec = json.loads(open(ladder.last_dump).read())
+        assert rec["reason"].startswith("escalation:stall")
+        # a healthy reset re-arms the black box for the NEXT incident
+        ladder.reset()
+        ladder.escalate("stall-again")
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flightrec_")]
+        assert len(dumps) == 2
+    finally:
+        if prev is not None:
+            flight_recorder.arm(prev)
+        else:
+            flight_recorder.disarm()
+
+
+def test_verify_mismatch_dumps_black_box(tmp_path):
+    """A tampered cohort row must trip the oracle AND leave a
+    postmortem (one per cohort, not one per step)."""
+    import jax
+
+    obs.metrics.reset()
+    prev = flight_recorder.armed_dir
+    try:
+        flight_recorder.arm(str(tmp_path), autodump=False)
+        ens, gol, mk = _gol_ensemble()
+        ens.scheduler.verify = True
+        ens.submit(gol, mk(), steps=4, tenant="alice")
+        ens.admit_pending()
+        (cohort,) = ens.cohorts.values()
+        cohort._verify_on = True
+        ens.step()
+        # corrupt the cohort BODY: its output diverges from the solo
+        # member program, which is exactly what the oracle audits
+        kernel = cohort._kernel
+        cohort._kernel = lambda args, state, dts, mask: (
+            jax.tree_util.tree_map(
+                lambda S: S + S.dtype.type(1),
+                kernel(args, state, dts, mask),
+            )
+        )
+        ens.step()
+        ens.step()
+        mism = sum(obs.metrics.report()["counters"]
+                   .get("ensemble.verify_mismatches", {}).values())
+        assert mism > 0
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flightrec_")]
+        assert len(dumps) == 1
+        rec = json.loads(open(os.path.join(tmp_path, dumps[0])).read())
+        assert rec["reason"] == "ensemble.verify_mismatch"
+    finally:
+        if prev is not None:
+            flight_recorder.arm(prev)
+        else:
+            flight_recorder.disarm()
+
+
+# ----------------------------------------------------- diff gate + CLI
+
+
+@pytest.fixture(scope="module")
+def diff():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import telemetry_diff
+    finally:
+        sys.path.pop(0)
+    return telemetry_diff
+
+
+def _latency_report(scale: float) -> dict:
+    reg = MetricsRegistry()
+    reg.set_histogram_resolution("ensemble.e2e_s", slo.SLO_RESOLUTION)
+    rng = np.random.default_rng(3)
+    for v in rng.lognormal(-2, 0.5, size=400):
+        reg.observe("ensemble.e2e_s", scale * float(v), tenant="a")
+    return reg.report()
+
+
+def test_diff_p99_ceiling_gate(diff, tmp_path):
+    base = _latency_report(1.0)
+    ok = _latency_report(1.0)
+    bad = _latency_report(3.0)
+    assert diff.compare_quantiles(
+        ok["histograms"], base["histograms"])["verdict"] == "PASS"
+    v = diff.compare_quantiles(bad["histograms"], base["histograms"])
+    assert v["verdict"] == "FAIL"
+    assert "p99" in v["failures"][0]
+    # vacuous without both sides
+    assert diff.compare_quantiles(
+        None, base["histograms"])["verdict"] == "PASS"
+    # end to end through the CLI entry point: an injected p99
+    # regression fails the round
+    bpath, cpath = tmp_path / "base.json", tmp_path / "cur.json"
+    bpath.write_text(json.dumps(base))
+    cpath.write_text(json.dumps(bad))
+    rc = diff.main(["--current", str(cpath), "--baseline", str(bpath),
+                    "--no-history"])
+    assert rc == 1
+    cpath.write_text(json.dumps(ok))
+    assert diff.main(["--current", str(cpath), "--baseline", str(bpath),
+                      "--no-history"]) == 0
+
+
+def test_slo_report_cli_offline(tmp_path):
+    """The acceptance criterion: per-tenant p50/p95/p99 and miss rates
+    from exported histograms alone — no live process."""
+    reg = MetricsRegistry()
+    for name in ("ensemble.queue_wait_s", "ensemble.e2e_s",
+                 "ensemble.service_s"):
+        reg.set_histogram_resolution(name, slo.SLO_RESOLUTION)
+    rng = np.random.default_rng(5)
+    for tenant in ("alice", "bob"):
+        for v in rng.lognormal(-3, 0.6, size=60):
+            reg.observe("ensemble.queue_wait_s", float(v), tenant=tenant)
+            reg.observe("ensemble.e2e_s", 3 * float(v), tenant=tenant)
+    reg.inc("ensemble.deadline_miss", 3, tenant="alice")
+    tel = tmp_path / "telemetry.json"
+    tel.write_text(json.dumps(reg.report()))
+    out_json = tmp_path / "slo.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "slo_report.py"),
+         str(tel), "--json", str(out_json)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "tenant=alice" in r.stdout and "p99" in r.stdout
+    rep = json.loads(out_json.read_text())
+    rows = {(row["metric"], row["labels"]): row for row in rep["latency"]}
+    row = rows[("ensemble.e2e_s", "tenant=alice")]
+    assert row["p50"] <= row["p95"] <= row["p99"]
+    assert rep["deadline_miss_rates"]["alice"]["missed"] == 3
+    assert rep["deadline_miss_rates"]["alice"]["rate"] == pytest.approx(
+        3 / 60)
+
+
+def test_slo_report_drilldown(tmp_path):
+    """Slowest-request drill-down: request.e2e spans cross-referenced
+    to overlapping kernel spans from other (device) pids."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import slo_report
+    finally:
+        sys.path.pop(0)
+    trace = {"traceEvents": [
+        {"name": "request.e2e", "ph": "B", "pid": 1, "tid": 0, "ts": 0.0,
+         "args": {"request": 5, "tenant": "alice"}},
+        {"name": "request.e2e", "ph": "E", "pid": 1, "tid": 0,
+         "ts": 9000.0},
+        {"name": "jit_gol_step", "ph": "X", "pid": 2, "tid": 0,
+         "ts": 1000.0, "dur": 7000.0},
+        {"name": "unrelated_kernel", "ph": "X", "pid": 2, "tid": 0,
+         "ts": 20000.0, "dur": 500.0},
+    ]}
+    slow = slo_report.slowest_requests(trace, top=3)
+    assert len(slow) == 1
+    assert slow[0]["request"] == 5
+    names = [k["name"] for k in slow[0]["kernels"]]
+    assert names == ["jit_gol_step"]
+
+
+def test_check_telemetry_required_sets_cover_slo():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_telemetry as ct
+    finally:
+        sys.path.pop(0)
+    assert "ensemble.deadline_miss" in ct.REQUIRED_NONZERO_COUNTERS
+    assert "flightrec.dumps" in ct.REQUIRED_NONZERO_COUNTERS
+    assert "flightrec.dump" in ct.REQUIRED_PHASES
+    assert set(ct.REQUIRED_HISTOGRAMS) >= {
+        "ensemble.queue_wait_s", "ensemble.e2e_s", "phase.duration_s",
+    }

@@ -46,6 +46,15 @@ Checks (exit 1 on any failure):
   heartbeat (warn → rescale-down → restart in order, leaving
   ``supervisor.warnings`` / ``supervisor.escalations`` /
   ``elastic.degraded``);
+* an SLO round (ISSUE 10): a deadline-mixed ensemble round must leave
+  the request-latency histograms (``ensemble.queue_wait_s`` /
+  ``ensemble.service_s`` / ``ensemble.e2e_s``) with sane quantile
+  ordering (p50 <= p95 <= p99 recovered from the exported buckets),
+  exact ``ensemble.deadline_miss`` counts and request lifecycle spans;
+  a forced supervisor escalation with the flight recorder armed must
+  produce exactly ONE schema-valid postmortem dump naming the round's
+  requests (``obs.validate_flightrec``); the overhead budget below runs
+  with the whole request plane on;
 * side artifacts (``<out>.stream.jsonl`` / ``.trace.json`` /
   ``.merged_trace.json``) land next to ``--out`` — or under ``tools/``
   when ``--out`` is the repo root's ``telemetry.json``, keeping bench
@@ -92,6 +101,8 @@ REQUIRED_PHASES = (
     # ISSUE 9: the ensemble probe's admit -> step -> retire round
     "ensemble.admit",
     "ensemble.step",
+    # ISSUE 10: the forced escalation must write its black box
+    "flightrec.dump",
 )
 
 #: counters that must be nonzero after the workload
@@ -137,6 +148,21 @@ REQUIRED_NONZERO_COUNTERS = (
     "ensemble.retired",
     "ensemble.steps_served",
     "ensemble.verify_checks",
+    # ISSUE 10: the deadline-mixed SLO round must count its misses
+    # (silent misses are exactly what the request plane exists to end)
+    # and the forced escalation must leave its postmortem evidence
+    "ensemble.deadline_miss",
+    "flightrec.dumps",
+)
+
+#: histograms that must carry samples after the probe (ISSUE 10): the
+#: per-request latency distributions the SLO report quantiles, and the
+#: phase-duration series the registry's observe_duration hook feeds
+REQUIRED_HISTOGRAMS = (
+    "ensemble.queue_wait_s",
+    "ensemble.service_s",
+    "ensemble.e2e_s",
+    "phase.duration_s",
 )
 
 
@@ -781,6 +807,148 @@ def _ensemble_probe() -> list:
     return failures
 
 
+def _slo_probe() -> list:
+    """Request-level SLO round (ISSUE 10).
+
+    Drives a deadline-mixed ensemble round (two tenants; half the
+    scenarios submitted with already-passed deadlines, half with far
+    ones) and requires the full request plane to materialize: the
+    ``ensemble.queue_wait_s`` / ``ensemble.e2e_s`` histograms with sane
+    quantile ordering (p50 <= p95 <= p99 from the exported buckets
+    alone), exact deadline-miss counts, and request lifecycle spans on
+    the timeline.  Then forces a supervisor escalation with the flight
+    recorder armed at a scratch directory: the ladder must produce
+    EXACTLY ONE schema-valid postmortem dump for the incident, naming
+    the round's request activity.  Returns failure strings."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.obs import flight_recorder, slo, validate_flightrec
+    from dccrg_tpu.resilience import EscalationLadder
+    from dccrg_tpu.serve import Ensemble
+
+    failures: list = []
+    prev_dir = flight_recorder.armed_dir
+    td = tempfile.mkdtemp(prefix="dccrg_slo_probe_")
+    try:
+        flight_recorder.arm(td, autodump=False)
+        n = 4
+        g = (
+            Grid()
+            .set_initial_length((n, n, n))
+            .set_neighborhood_length(0)
+            .set_periodic(True, True, True)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / n,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        g.stop_refining()
+        gol = GameOfLife(g, allow_dense=False)
+        cells = g.get_cells()
+        rng = np.random.default_rng(1)
+        mk = lambda: gol.new_state(
+            alive_cells=cells[rng.random(len(cells)) < 0.3]
+        )
+        before_miss = int(sum(
+            obs.metrics.report()["counters"]
+            .get("ensemble.deadline_miss", {}).values()
+        ))
+        ens = Ensemble(policy="deadline")
+        now = time.perf_counter()
+        expect_missed = 0
+        for i in range(6):
+            # even submissions carry deadlines that already passed —
+            # guaranteed misses; odd ones have a generous hour
+            past = i % 2 == 0
+            ens.submit(gol, mk(), steps=2 + i % 3,
+                       tenant=f"tenant{i % 2}",
+                       deadline=now - 1.0 if past else now + 3600.0)
+            expect_missed += past
+        ens.run()
+
+        rep = obs.metrics.report()
+        for name in ("ensemble.queue_wait_s", "ensemble.e2e_s",
+                     "ensemble.service_s"):
+            series = rep["histograms"].get(name)
+            if not series:
+                failures.append(
+                    f"slo probe: histogram {name!r} missing after the "
+                    "deadline-mixed round"
+                )
+                continue
+            for label, h in series.items():
+                p50, p95, p99 = (slo.quantile(h, q)
+                                 for q in (0.5, 0.95, 0.99))
+                if p50 is None or not (p50 <= p95 <= p99):
+                    failures.append(
+                        f"slo probe: {name}{{{label}}} quantiles out of "
+                        f"order: p50={p50} p95={p95} p99={p99}"
+                    )
+        missed = int(sum(
+            rep["counters"].get("ensemble.deadline_miss", {}).values()
+        )) - before_miss
+        if missed != expect_missed:
+            failures.append(
+                f"slo probe: {missed} deadline misses counted, expected "
+                f"exactly {expect_missed} (past-deadline submissions)"
+            )
+        span_names = {s["name"] for s in obs.timeline.spans()}
+        for wanted in ("request.queued", "request.step", "request.e2e"):
+            if wanted not in span_names:
+                failures.append(
+                    f"slo probe: lifecycle span {wanted!r} missing from "
+                    "the timeline after the serving round"
+                )
+
+        # forced escalation -> exactly one postmortem for the incident
+        ladder = EscalationLadder()
+        for _ in range(3):
+            ladder.escalate("slo-probe-stall")
+        dumps = sorted(
+            p for p in os.listdir(td)
+            if p.startswith("flightrec_") and p.endswith(".json")
+        )
+        if len(dumps) != 1:
+            failures.append(
+                f"slo probe: forced escalation left {len(dumps)} "
+                f"flight-recorder dumps ({dumps}), wanted exactly one "
+                "per incident"
+            )
+        for p in dumps:
+            full = os.path.join(td, p)
+            failures += [f"flightrec {p}: {f}"
+                         for f in validate_flightrec(full)]
+            with open(full) as f:
+                rec = json.load(f)
+            named = any(
+                str(ev.get("kind", "")).startswith("request.")
+                for ev in rec.get("events", [])
+            ) or any(
+                str(sp.get("name", "")).startswith("request.")
+                for sp in rec.get("spans", [])
+            )
+            if not named:
+                failures.append(
+                    f"slo probe: postmortem {p} names no request "
+                    "activity from the serving round"
+                )
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"slo probe failed: {e!r}")
+    finally:
+        if prev_dir is not None:
+            flight_recorder.arm(prev_dir)
+        else:
+            flight_recorder.disarm()
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+    return failures
+
+
 def _device_timeline_probe(g, adv, state, dt, out_path: str,
                            merged_path: str | None = None) -> list:
     """Profiled round (ISSUE 6): capture one split-phase drive under
@@ -922,6 +1090,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     failures += _churn_probe(g, dt)
     failures += _halo_backend_probe()
     failures += _ensemble_probe()
+    failures += _slo_probe()
 
     if not skip_overhead:
         # measured BEFORE the profiled round: the xplane ingest/merge
@@ -946,6 +1115,11 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
         series = report["counters"].get(counter, {})
         if not any(v > 0 for v in series.values()):
             failures.append(f"counter {counter!r} recorded no value")
+    for hist in REQUIRED_HISTOGRAMS:
+        series = report["histograms"].get(hist, {})
+        if not any(h.get("count", 0) > 0 for h in series.values()):
+            failures.append(f"histogram {hist!r} recorded no samples — "
+                            "the SLO plane lost its distribution")
 
     rep = obs.export_json(out_path, extra={
         "workload": f"advection 8^3 refined-ball, {steps} steps, "

@@ -35,6 +35,13 @@ fixed-mesh reference bit-identically (GoL exact, advection 1e-11),
 and a fork-a-fresh-process warm-start proof must then resume from the
 lineage with ``epoch.recompiles == 0`` on the held ShapeSignature
 (the persistent compilation cache, ``DCCRG_COMPILE_CACHE_DIR``).
+
+Black box (ISSUE 10): crash and elastic children arm the flight
+recorder (``obs/flightrec.py``) at their workdir — the ring checkpoints
+to ``flightrec_<pid>.json`` every 0.5 s and each step marks its unit in
+flight first — and the drivers assert that every killed attempt left a
+schema-valid postmortem naming the step it was serving when it died
+(:func:`check_flightrec_dump`).
 """
 import argparse
 import pathlib
@@ -786,6 +793,12 @@ from dccrg_tpu.resilience.manager import CheckpointLineage
 
 obs.stream_to(os.path.join(wd, 'child_stream.jsonl'), period=2.0,
               extra={'subsystem': 'crash', 'seed': seed, 'n_devices': nd})
+# black box (ISSUE 10): the ring checkpoints itself to
+# flightrec_<pid>.json in the workdir, so even a SIGKILL mid-step
+# leaves a schema-valid postmortem naming the unit in flight — the
+# driver asserts this for every killed attempt
+from dccrg_tpu.obs import flightrec as _flightrec
+_flightrec.recorder.arm(wd, period=0.5)
 # per-child timeline export at exit: carries origin_unix_s, the anchor
 # the post-run fleet merge (obs.merge_chrome_traces) unifies children on.
 # A SIGKILLed attempt leaves no trace file — the surviving attempts'
@@ -825,6 +838,8 @@ if not os.path.exists(final):
         step = 0
         print('FRESH gol', flush=True)
     while step < total:
+        _flightrec.recorder.mark_unit('gol/%d' % step, tenant='soak',
+                                      phase='gol', step=step)
         s = gol.run(s, 1)
         step += 1
         if step % every == 0:
@@ -875,6 +890,8 @@ if not os.path.exists(final):
         step = 0
         print('FRESH adv', flush=True)
     while step < total:
+        _flightrec.recorder.mark_unit('adv/%d' % step, tenant='soak',
+                                      phase='adv', step=step)
         s = adv.step(s, dt)
         step += 1
         if step % every == 0:
@@ -884,6 +901,42 @@ if not os.path.exists(final):
 
 print('CRASH_CHILD_DONE', flush=True)
 """
+
+
+def check_flightrec_dump(workdir: str, context: str,
+                         require_inflight: bool = True) -> list:
+    """Driver-side black-box assertion (ISSUE 10): a killed child must
+    have left a parseable ``flightrec_*.json`` postmortem in its workdir
+    naming the unit(s) it had in flight.  Returns failure strings.
+
+    ``require_inflight=False`` relaxes the victim-naming requirement to
+    "only if the dump shows stepping ever began" (any ``unit`` event in
+    the ring) — the crash harness kills at RANDOM wall-clock times that
+    can land in the sliver between arming and the first step."""
+    import glob as _glob
+    import json
+    import os
+
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    from dccrg_tpu.obs.flightrec import validate_flightrec
+
+    files = _glob.glob(os.path.join(workdir, "flightrec_*.json"))
+    if not files:
+        return [f"{context}: killed child left no flight-recorder dump"]
+    newest = max(files, key=os.path.getmtime)
+    name = os.path.basename(newest)
+    fails = [f"{context}: {name}: {f}" for f in validate_flightrec(newest)]
+    if fails:
+        return fails
+    with open(newest) as f:
+        rec = json.load(f)
+    stepped = any(ev.get("kind") == "unit"
+                  for ev in rec.get("events", []))
+    if (require_inflight or stepped) and not rec.get("in_flight"):
+        return [f"{context}: postmortem {name} names no in-flight "
+                "request"]
+    return []
 
 
 def run_crash(lo: int, hi: int, stream_dir: str | None = None,
@@ -1008,6 +1061,21 @@ def run_crash(lo: int, hi: int, stream_dir: str | None = None,
                        kill=kill_mode, exit=rc, resumes=resumes_of(wd))
                 if rc == 0:
                     break
+                # ISSUE 10: every killed attempt that reached the
+                # workload must have left its black box (random-time
+                # kills can land before arming — resumes_of is the
+                # evidence the child got that far)
+                if resumes_of(wd):
+                    probs = check_flightrec_dump(
+                        wd, f"crash seed {seed} attempt {attempt}",
+                        require_inflight=False,
+                    )
+                    for p in probs:
+                        print(f"  FLIGHTREC: {p}")
+                    if probs:
+                        record(seed=seed, attempt=attempt,
+                               outcome="flightrec-missing")
+                        ok_all = False
             if rc != 0:
                 print(f"crash seed {seed}: no attempt completed "
                       f"(last rc={rc})")
@@ -1090,6 +1158,11 @@ hb = os.environ.get('DCCRG_ELASTIC_HEARTBEAT',
                     os.path.join(wd, 'heartbeat.jsonl'))
 stream = obs.stream_to(hb, period=0.5,
                        extra={'subsystem': 'elastic', 'seed': seed})
+# black box (ISSUE 10): armed at the workdir so every killed attempt
+# (watchdog rescue, device loss, SIGKILL) leaves flightrec_<pid>.json
+# naming the step that was in flight — asserted by the driver
+from dccrg_tpu.obs import flightrec as _flightrec
+_flightrec.recorder.arm(wd, period=0.5)
 
 ADV_SPEC = {k: ((), np.float64) for k in ('density', 'vx', 'vy', 'vz')}
 
@@ -1121,7 +1194,11 @@ def schedules(phase):
 def step_hooks(phase, step):
     '''Per-step fault seams: a hang wedges the loop (the supervisor's
     heartbeat watchdog must catch it); a device loss aborts to exit 42
-    (the supervisor must relaunch degraded).'''
+    (the supervisor must relaunch degraded).  The unit is marked in the
+    flight recorder FIRST, so whichever fault fires, the postmortem
+    names this step as the victim.'''
+    _flightrec.recorder.mark_unit('%s/%d' % (phase, step), tenant='soak',
+                                  phase=phase, step=step)
     stream.write_snapshot(phase=phase, step=step)
     inject.maybe_raise('device.lost', DeviceLostError, where='step')
     inject.maybe_hang('step.hang', seconds=600.0)
@@ -1483,6 +1560,18 @@ def run_elastic(lo: int, hi: int, stream_dir: str | None = None,
                       f"{outcome} rc={rc}", flush=True)
                 if outcome == "exited" and rc == 0:
                     break
+                # ISSUE 10: a killed/faulted attempt must leave its
+                # black box naming the step it was serving — the hang
+                # wedges AFTER the unit is marked and the checkpoint
+                # ticks every 0.5s, so the postmortem is always there
+                probs = check_flightrec_dump(
+                    wd, f"elastic seed {seed} attempt {attempt}")
+                for p in probs:
+                    print(f"  FLIGHTREC: {p}")
+                if probs:
+                    record(seed=seed, attempt=attempt,
+                           outcome="flightrec-missing")
+                    ok_all = False
                 # degraded relaunch at fewer devices after a watchdog
                 # rescale-down or a device loss (exit 42); a restart
                 # keeps the count

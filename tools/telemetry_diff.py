@@ -176,6 +176,12 @@ DEFAULT_ALLOW = (
     "ensemble.admit",
     "ensemble.step",
     "ensemble.verify",
+    # ISSUE 10 flight-recorder phase: a dump's cost is sized by the ring
+    # contents and how many postmortems the round's incidents triggered
+    # — workload-shaped, not a perf regression.  The SLO regression the
+    # gate DOES watch is the request-latency quantile ceiling
+    # (GATED_QUANTILES below).
+    "flightrec.dump",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
@@ -199,6 +205,127 @@ GATED_GAUGES_MIN = (
     # returns to 0 after retirement) would be noise.
     "ensemble.cohort_peak_occupancy",
 )
+
+
+#: request-latency histograms whose upper quantile is CEILING-gated
+#: round-over-round (ISSUE 10): per labeled series, the current round's
+#: p99 may not exceed the baseline's by more than the threshold — the
+#: request-level analogue of the phase-mean gate.  Engages only when
+#: both rounds carry the series with enough samples; the quantile comes
+#: from the exported log buckets (obs/slo.py), so the gate needs no
+#: live process.
+GATED_QUANTILES = (
+    ("ensemble.queue_wait_s", 0.99),
+    ("ensemble.e2e_s", 0.99),
+    ("ensemble.service_s", 0.99),
+)
+
+#: baseline p99s below this many seconds are bucket-resolution noise,
+#: not a meaningful ceiling (a 50µs p99 doubling is jitter)
+QUANTILE_MIN_BASE_S = 1e-4
+
+_SLO = None
+
+
+def _slo():
+    """Lazy file-load of ``dccrg_tpu/obs/slo.py`` (stdlib-only by
+    contract) — the quantile estimator, without importing the package
+    (and thus jax) into this gate."""
+    global _SLO
+    if _SLO is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "dccrg_slo", str(ROOT / "dccrg_tpu" / "obs" / "slo.py"))
+        _SLO = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_SLO)
+    return _SLO
+
+
+def load_histograms(path: str) -> dict | None:
+    """Histogram table ``{name: {labels: hist}}`` from the same shapes
+    :func:`load_phases` reads, or None when the source carries none."""
+    p = pathlib.Path(path)
+    try:
+        text = p.read_text()
+        if p.suffix == ".jsonl" or "\n{" in text.strip():
+            last = None
+            for ln in text.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "histograms" in rec:
+                    last = rec
+            return dict(last["histograms"]) if last else None
+        data = json.loads(text)
+        if "histograms" in data:
+            return dict(data["histograms"])
+        tel = (data.get("detail") or {}).get("telemetry") or {}
+        if "histograms" in tel:
+            return dict(tel["histograms"])
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    return None
+
+
+def compare_quantiles(current: dict | None, baseline: dict | None,
+                      threshold: float = 0.35, gated=GATED_QUANTILES,
+                      min_base_s: float = QUANTILE_MIN_BASE_S,
+                      min_count: int = 2) -> dict:
+    """Ceiling gate on per-label latency quantiles: fails when a gated
+    series' quantile exceeds ``baseline * (1 + threshold)``.  Either
+    side lacking the table, the series, or enough samples passes
+    vacuously — label sets legitimately differ per round (tenants come
+    and go), so a missing label only informs."""
+    rows = []
+    failures = []
+    if current is None or baseline is None:
+        return {"verdict": "PASS", "rows": rows, "failures": failures}
+    slo = _slo()
+    for name, q in gated:
+        base_series = baseline.get(name)
+        if not base_series:
+            continue
+        cur_series = current.get(name) or {}
+        for label, bh in base_series.items():
+            ch = cur_series.get(label)
+            row = {"histogram": name, "labels": label, "q": q}
+            if not isinstance(bh, dict) or bh.get("count", 0) < min_count:
+                row["status"] = "below-sample-floor"
+                rows.append(row)
+                continue
+            bq = slo.quantile(bh, q)
+            row["base"] = bq
+            if ch is None or not isinstance(ch, dict) \
+                    or ch.get("count", 0) < min_count:
+                row["status"] = "missing-label"
+                rows.append(row)
+                continue
+            cq = slo.quantile(ch, q)
+            row["cur"] = cq
+            if bq is None or cq is None or bq < min_base_s:
+                row["status"] = "below-noise-floor"
+            elif cq > bq * (1.0 + threshold):
+                row["status"] = "REGRESSED"
+                row["ratio"] = round(cq / bq, 3)
+                failures.append(
+                    f"{name}{{{label}}} p{round(q * 100)}: "
+                    f"{bq:.6f}s -> {cq:.6f}s ({cq / bq:.2f}x, ceiling "
+                    f"{1 + threshold:.2f}x)"
+                )
+            else:
+                row["status"] = "ok"
+                row["ratio"] = round(cq / max(bq, 1e-12), 3)
+            rows.append(row)
+    return {
+        "verdict": "FAIL" if failures else "PASS",
+        "rows": rows,
+        "failures": failures,
+    }
 
 
 def load_gauges(path: str) -> dict | None:
@@ -537,6 +664,19 @@ def main(argv=None) -> int:
         verdict["verdict"] = "FAIL"
         verdict["failures"] = list(verdict["failures"]) + ggate["failures"]
 
+    # quantile ceiling gate (ISSUE 10): the request-latency p99s may
+    # not blow past the baseline's — a serving round whose tail latency
+    # regressed fails even when every phase MEAN stayed flat (tails
+    # hide in means; that is the point of the SLO plane)
+    qgate = compare_quantiles(
+        load_histograms(args.current), load_histograms(baseline_path),
+        threshold=args.threshold,
+    )
+    verdict["quantile_gate"] = qgate
+    if qgate["verdict"] == "FAIL":
+        verdict["verdict"] = "FAIL"
+        verdict["failures"] = list(verdict["failures"]) + qgate["failures"]
+
     # cumulative-drift gate over the retained history window (the
     # round-over-round step gate above cannot see slow creep)
     hist_path = None if args.no_history else args.history
@@ -567,6 +707,13 @@ def main(argv=None) -> int:
             if "ratio" in row:
                 parts.append(f"({row['ratio']:.2f}x)")
         print("  ".join(parts))
+    if verdict["quantile_gate"]["rows"]:
+        qg = verdict["quantile_gate"]
+        gated_n = sum(1 for r in qg["rows"]
+                      if r["status"] in ("ok", "REGRESSED"))
+        print(f"telemetry_diff: p99 ceiling {qg['verdict']} "
+              f"({gated_n} labeled series gated, threshold "
+              f"{1 + args.threshold:.2f}x)")
     if "drift" in verdict:
         d = verdict["drift"]
         print(f"telemetry_diff: drift {d['verdict']} vs oldest of "
