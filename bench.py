@@ -741,6 +741,23 @@ def measure_split_fused() -> dict:
     return out
 
 
+def measure_deep_dispatch() -> dict:
+    """ISSUE 11 on-chip target: the deep-dispatch ensemble sweep —
+    scenarios·steps/sec/chip at cohort sizes {1, 64, 256} for
+    k ∈ {1, 4, 16} steps per host dispatch, with per-member cohort HBM
+    under donation + broadcast-shared tables and the oracle counts —
+    run wherever the tunnel lands it (the host round-trip this
+    amortizes is far larger against a real accelerator)."""
+    import jax
+
+    from benchmarks.microbench import ensemble_summary
+
+    out = ensemble_summary(sizes=(1, 64, 256), ks=(1, 4, 16))
+    out["device_kind"] = jax.devices()[0].device_kind
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def measure_multidev_cpu() -> dict | None:
     """8-device virtual CPU mesh (subprocess): plumbing/correctness
     evidence (device-count-invariant checksum) plus the split-phase
@@ -1218,12 +1235,14 @@ def _attach_elastic(record: dict) -> None:
 
 
 def _attach_ensemble(record: dict) -> None:
-    """Fold the scenario-multiplexing sweep (ISSUE 9) into the record
-    under ``detail.telemetry.ensemble``: scenarios·steps/sec/chip for
-    cohort sizes {1, 8, 64, 256} vs solo stepping — the serving
-    headline beside cell-updates/sec.  Run on the 8-device virtual CPU
-    mesh in a child so an accelerator outage never blocks the bench
-    line."""
+    """Fold the scenario-multiplexing sweep (ISSUE 9 + 11) into the
+    record under ``detail.telemetry.ensemble``: scenarios·steps/sec/
+    chip for cohort sizes {1, 64, 256} at deep-dispatch depths
+    k ∈ {1, 4, 16} vs solo stepping — the serving headline beside
+    cell-updates/sec — plus per-member cohort HBM under donation +
+    shared tables and the per-k oracle counts.  Run on the 8-device
+    virtual CPU mesh in a child so an accelerator outage never blocks
+    the bench line."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"

@@ -593,20 +593,28 @@ def elastic_summary(length: int = 6, seed: int = 0) -> dict:
     }
 
 
-def ensemble_summary(length: int = 4, steps: int = 20,
-                     sizes=(1, 8, 64, 256), seed: int = 0) -> dict:
-    """Scenario-multiplexing throughput (ISSUE 9): scenarios·steps/sec
-    per chip for cohort sizes ``sizes`` vs solo stepping, importable so
-    ``bench.py`` folds it into ``detail.telemetry.ensemble``.
+def ensemble_summary(length: int = 4, steps: int = 16,
+                     sizes=(1, 64, 256), ks=(1, 4, 16),
+                     seed: int = 0) -> dict:
+    """Scenario-multiplexing throughput (ISSUE 9 + 11):
+    scenarios·steps/sec per chip for cohort sizes ``sizes`` at deep-
+    dispatch depths ``ks`` vs solo stepping, importable so ``bench.py``
+    folds it into ``detail.telemetry.ensemble``.
 
     One GoL grid on the general gather path (the representative
-    runtime-argument form — every member's tables ride the stacked
-    leading axis); ``B`` independent initial conditions admitted into
-    one cohort and stepped through the single compiled cohort body.
-    ``solo`` is the same model's own step loop — the baseline a tenant
-    would get with the hardware to itself.  ``amortization`` per cohort
-    size is the cohort's scenarios·steps/sec over solo's: how many
-    near-free scenarios the leading axis buys on this backend."""
+    runtime-argument form); ``B`` independent initial conditions
+    admitted into one cohort and stepped through the single compiled
+    cohort body, ``k`` interior steps per host dispatch (ISSUE 11's
+    deep dispatch — the ``fori_loop`` bodies pay the host round-trip
+    once per k steps).  ``solo`` is the same model's own step loop —
+    the baseline a tenant would get with the hardware to itself.
+    ``amortization`` is the cohort's scenarios·steps/sec over solo's.
+    Each (B, k) cell also reports the measured per-member cohort
+    memory (``hbm_bytes_per_member`` — broadcast-shared tables counted
+    once) beside the pre-ISSUE-11 stacked-tables equivalent, and a
+    small oracle-armed round per k reports verify check/mismatch
+    counts (``verify``) so the throughput table never outruns the
+    bit-identity anchor."""
     import jax
 
     from dccrg_tpu import CartesianGeometry, Grid, make_mesh
@@ -657,43 +665,92 @@ def ensemble_summary(length: int = 4, steps: int = 20,
         "n_devices": g.n_devices,
         "n_cells": int(len(cells)),
         "steps": steps,
+        "ks": [int(k) for k in ks],
         "solo_step_s": round(solo_s, 6),
         "solo_scenario_steps_per_s_per_chip": round(solo_rate, 1),
         "cohorts": {},
+        "verify": {},
     }
     for B in sizes:
-        sched = Scheduler()
-        for i in range(B):
-            sched.submit(Scenario(gol, fresh_state(), steps + 1,
-                                  tenant=f"t{i}"))
-        sched.admit()
-        sched.step_once()                         # warm the cohort body
-        sync(sched)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            sched.step_once()
-        sync(sched)
-        step_s = (time.perf_counter() - t0) / steps
-        rate = B / max(step_s, 1e-12) / chips
-        out["cohorts"][str(B)] = {
-            "cohort_step_s": round(step_s, 6),
-            "scenarios_steps_per_s_per_chip": round(rate, 1),
-            "amortization_vs_solo": round(rate / max(solo_rate, 1e-12),
-                                          2),
-        }
+        ent: dict = {"k": {}}
+        for k in ks:
+            sched = Scheduler(steps_per_dispatch=k)
+            iters = max(1, steps // k)
+            for i in range(B):
+                sched.submit(Scenario(gol, fresh_state(),
+                                      k * (iters + 1), tenant=f"t{i}"))
+            sched.admit()
+            sched.step_once()                 # warm the depth-k body
+            sync(sched)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sched.step_once()
+            sync(sched)
+            elapsed = time.perf_counter() - t0
+            rate = B * k * iters / max(elapsed, 1e-12) / chips
+            cohort = next(iter(sched.cohorts.values()))
+            ent["k"][str(k)] = {
+                "dispatch_s": round(elapsed / iters, 6),
+                "step_s": round(elapsed / (iters * k), 6),
+                "scenarios_steps_per_s_per_chip": round(rate, 1),
+                "amortization_vs_solo": round(
+                    rate / max(solo_rate, 1e-12), 2),
+                "hbm_bytes_per_member": cohort.member_hbm_bytes(),
+                "hbm_bytes_per_member_stacked_tables":
+                    cohort.member_hbm_bytes_stacked_tables(),
+                "shared_tables": bool(cohort.shared_args),
+            }
+        # headline row per cohort size = its deepest dispatch
+        deepest = ent["k"][str(max(ks))]
+        ent.update({
+            "cohort_step_s": deepest["step_s"],
+            "scenarios_steps_per_s_per_chip":
+                deepest["scenarios_steps_per_s_per_chip"],
+            "amortization_vs_solo": deepest["amortization_vs_solo"],
+        })
+        out["cohorts"][str(B)] = ent
+    # oracle sanity per depth: a tiny verified round (the bit-identity
+    # anchor must hold at every k the sweep reports numbers for)
+    def _verify_totals() -> tuple:
+        rep = _registry_report()
+        return tuple(
+            int(sum(rep["counters"].get(name, {}).values()))
+            for name in ("ensemble.verify_checks",
+                         "ensemble.verify_mismatches")
+        )
+
+    for k in ks:
+        c0, m0 = _verify_totals()
+        sched = Scheduler(steps_per_dispatch=k, verify=True)
+        for i in range(2):
+            sched.submit(Scenario(gol, fresh_state(), 2 * k,
+                                  tenant=f"v{i}"))
+        sched.run()
+        c1, m1 = _verify_totals()
+        out["verify"][str(k)] = {"checks": c1 - c0,
+                                 "mismatches": m1 - m0}
     return out
 
 
-def bench_ensemble(length: int = 4, steps: int = 20):
+def _registry_report() -> dict:
+    from dccrg_tpu import obs
+
+    return obs.metrics.report()
+
+
+def bench_ensemble(length: int = 4, steps: int = 16):
     """Print the :func:`ensemble_summary` sweep as a bench metric:
-    value = scenarios·steps/sec/chip at the largest cohort size — the
-    serving-throughput headline beside cell-updates/sec."""
+    value = scenarios·steps/sec/chip at the largest cohort size and
+    deepest dispatch — the serving-throughput headline beside
+    cell-updates/sec."""
     s = ensemble_summary(length=length, steps=steps)
     largest = max(s["cohorts"], key=int)
+    deepest = max(s["ks"])
     print(json.dumps({
         "metric": "ensemble_scenarios_steps_per_sec_per_chip",
         "value": s["cohorts"][largest]["scenarios_steps_per_s_per_chip"],
-        "unit": f"scenarios*steps/s/chip (cohort {largest})",
+        "unit": (f"scenarios*steps/s/chip (cohort {largest}, "
+                 f"k={deepest})"),
         "detail": s,
     }))
 

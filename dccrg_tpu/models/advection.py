@@ -1147,16 +1147,26 @@ class Advection:
         scenarios on a leading axis and vmap one jitted cohort body over
         them.  Works for the dense fast path (tables are closed-over
         pure functions of the kernel key) and both general gather forms
-        (tables ride along per member as stacked arguments)."""
-        from ..parallel.exec_cache import BatchStepSpec
+        (tables ride along per member as stacked arguments).  The
+        spec's ``steps_per_dispatch`` declares the default deep-dispatch
+        depth (``DCCRG_ENSEMBLE_K``, ISSUE 11): the serving tier wraps
+        ``call`` in a device-side ``fori_loop`` advancing that many
+        interior steps per host dispatch — each step's halo exchange
+        runs inside the loop body, so the in-kernel protocol is
+        identical to ``step`` called k times."""
+        from ..parallel.exec_cache import (
+            BatchStepSpec,
+            default_steps_per_dispatch,
+        )
 
+        k = default_steps_per_dispatch()
         dtype = np.dtype(self.dtype)
         if self.dense is not None:
             step = self._step
             return BatchStepSpec(
                 kind="advection.dense", kernel_key=self._dense_key,
                 call=lambda args, state, dt: step(state, dt),
-                args=(), dt_dtype=dtype,
+                args=(), dt_dtype=dtype, steps_per_dispatch=k,
             )
         if self.overlap:
             fn = self._split_fn
@@ -1165,6 +1175,7 @@ class Advection:
                 kernel_key=self._kernel_key("advection.split_step"),
                 call=lambda args, state, dt: fn(*args, state, dt),
                 args=self._split_args, dt_dtype=dtype,
+                steps_per_dispatch=k,
             )
         fn = self._step_fn
         return BatchStepSpec(
@@ -1172,7 +1183,7 @@ class Advection:
             kernel_key=self._kernel_key("advection.step"),
             call=lambda args, state, dt: fn(*args, state, dt),
             args=(self._rings, self.tables.tree(), self._dev),
-            dt_dtype=dtype,
+            dt_dtype=dtype, steps_per_dispatch=k,
         )
 
     def _record_run(self, path: str, steps, state) -> None:

@@ -401,9 +401,14 @@ class GameOfLife:
     def batch_step_spec(self):
         """Cohort-batchable step entry point (ISSUE 9; see
         ``Advection.batch_step_spec``).  GoL takes no dt — the cohort's
-        per-member dt operand is ignored."""
-        from ..parallel.exec_cache import BatchStepSpec
+        per-member dt operand is ignored.  ``steps_per_dispatch``
+        declares the deep-dispatch default (ISSUE 11)."""
+        from ..parallel.exec_cache import (
+            BatchStepSpec,
+            default_steps_per_dispatch,
+        )
 
+        k = default_steps_per_dispatch()
         ex = self._exchange
         if self.tables is None:          # overlap=True split-phase form
             fn = self._overlap_fn
@@ -417,12 +422,13 @@ class GameOfLife:
                 kind="gol.overlap",
                 kernel_key=("gol.overlap_step", ex.structure_key),
                 call=call, args=self._overlap_args,
+                steps_per_dispatch=k,
             )
         fn = self._step_fn
         return BatchStepSpec(
             kind="gol", kernel_key=("gol.step", ex.structure_key),
             call=lambda args, state, dt: fn(args[0], args[1], state),
-            args=self._step_args,
+            args=self._step_args, steps_per_dispatch=k,
         )
 
     def run(self, state, turns: int, sync_every: int = 16):

@@ -531,15 +531,19 @@ class Vlasov:
         ``Advection.batch_step_spec``).  ``nv`` rides the kernel key:
         two cohorts with different velocity-space resolutions compile
         different member programs even at one spatial signature."""
-        from ..parallel.exec_cache import BatchStepSpec
+        from ..parallel.exec_cache import (
+            BatchStepSpec,
+            default_steps_per_dispatch,
+        )
 
+        k = default_steps_per_dispatch()
         dtype = np.dtype(self.dtype)
         if self.info is not None:
             step = self._step
             return BatchStepSpec(
                 kind="vlasov.dense", kernel_key=self._dense_key,
                 call=lambda args, state, dt: step(state, dt),
-                args=(), dt_dtype=dtype,
+                args=(), dt_dtype=dtype, steps_per_dispatch=k,
             )
         ex = self._exchange
         if self.overlap:
@@ -550,6 +554,7 @@ class Vlasov:
                             str(dtype), self._has_open, self.nv),
                 call=lambda args, state, dt: fn(*args, state, dt),
                 args=self._split_args, dt_dtype=dtype,
+                steps_per_dispatch=k,
             )
         fn = self._gen_fn
         return BatchStepSpec(
@@ -557,7 +562,7 @@ class Vlasov:
             kernel_key=("vlasov.step", ex.structure_key, str(dtype),
                         self._has_open, self.nv),
             call=lambda args, state, dt: fn(*args, state, dt),
-            args=self._gen_args, dt_dtype=dtype,
+            args=self._gen_args, dt_dtype=dtype, steps_per_dispatch=k,
         )
 
     def _record_run(self, path: str, steps, state) -> None:

@@ -9,12 +9,24 @@ at bench checkpoints (``bench.py`` after each measurement).
 
 Backends without allocator stats (CPU returns ``None``; some plugins
 raise) record nothing — the gauges simply stay absent there.
+
+Ensemble memory accounting (ISSUE 11): allocator stats are per device
+and absent on CPU, but the serving tier's headline memory question —
+*how many scenarios fit one chip* — is per MEMBER.
+:func:`sample_ensemble_hbm` records the
+``ensemble.hbm_bytes_per_member{model}`` gauge from the cohort's own
+buffer sizes (works on every backend, so CI can gate it): unique table
+buffers counted ONCE under broadcast-shared tables, the stacked state
+priced at its dispatch-time in-flight cost (2x without effective
+donation — input and output coexist — 1x with).  Sampled at cohort
+build and every step; ``tools/telemetry_diff.py`` CEILING-gates it so
+the donation + shared-table wins cannot silently regress.
 """
 from __future__ import annotations
 
 from .registry import metrics
 
-__all__ = ["sample_hbm"]
+__all__ = ["sample_hbm", "sample_ensemble_hbm"]
 
 #: the allocator stats worth tracking round-over-round (when present)
 _STAT_KEYS = (
@@ -57,3 +69,20 @@ def sample_hbm(registry=None, devices=None) -> dict:
         if rec:
             out[dev_id] = rec
     return out
+
+
+def sample_ensemble_hbm(model: str, bytes_per_member: int,
+                        registry=None) -> int | None:
+    """Record the per-member cohort memory gauge
+    ``ensemble.hbm_bytes_per_member{model=...}`` (see module
+    docstring); returns the recorded value, or None when telemetry is
+    disabled.  The value is computed by the cohort
+    (:meth:`dccrg_tpu.serve.ensemble.Cohort.member_hbm_bytes`) — this
+    seam only owns the gauge name and registry routing so tools and
+    tests have ONE spelling to assert on."""
+    reg = registry if registry is not None else metrics
+    if not reg.enabled:
+        return None
+    v = int(bytes_per_member)
+    reg.gauge("ensemble.hbm_bytes_per_member", v, model=str(model))
+    return v

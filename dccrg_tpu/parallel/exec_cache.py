@@ -58,6 +58,8 @@ __all__ = [
     "ExecutableCache",
     "BatchStepSpec",
     "cohort_key",
+    "default_steps_per_dispatch",
+    "max_steps_per_dispatch",
     "traced_jit",
     "note_trace",
     "trace_counts",
@@ -96,6 +98,15 @@ class BatchStepSpec(NamedTuple):
       tables, gather/face tables...).  Empty for closure-based dense
       fast paths, whose tables are pure functions of the kernel_key.
     * ``dt_dtype`` — dtype the member expects dt in (None = unused).
+    * ``steps_per_dispatch`` — how many interior simulation steps ONE
+      host dispatch of the cohort body advances (ISSUE 11, "deep
+      dispatch"): the member ``call`` is wrapped in a ``lax.fori_loop``
+      stepping k times inside the single vmapped jitted program, so the
+      host round-trip is paid once per k steps instead of once per
+      step.  This is the model's declared default (fed by
+      ``DCCRG_ENSEMBLE_K``); the scheduler may pick a different depth
+      per dispatch from deadline slack and per-member remaining budgets
+      — each distinct depth is its own cached executable.
     """
 
     kind: str
@@ -103,14 +114,49 @@ class BatchStepSpec(NamedTuple):
     call: object
     args: tuple = ()
     dt_dtype: object = None
+    steps_per_dispatch: int = 1
 
 
-def cohort_key(spec: "BatchStepSpec", width: int) -> tuple:
+def max_steps_per_dispatch() -> int:
+    """Cap on the deep-dispatch depth k (``DCCRG_ENSEMBLE_K_MAX``,
+    default 64): bounds both compile-cache cardinality (one body per
+    distinct k) and how stale the host's occupancy view may go between
+    dispatches."""
+    try:
+        cap = int(os.environ.get("DCCRG_ENSEMBLE_K_MAX", 64))
+    except ValueError:
+        return 64
+    return max(cap, 1)
+
+
+def default_steps_per_dispatch() -> int:
+    """The process-default deep-dispatch depth (``DCCRG_ENSEMBLE_K``,
+    default 1 — one simulation step per host dispatch, the pre-ISSUE-11
+    behavior), clamped to [1, :func:`max_steps_per_dispatch`]."""
+    try:
+        k = int(os.environ.get("DCCRG_ENSEMBLE_K", 1))
+    except ValueError:
+        return 1
+    return max(1, min(k, max_steps_per_dispatch()))
+
+
+def cohort_key(spec: "BatchStepSpec", width: int,
+               steps_per_dispatch: int | None = None,
+               shared_args: bool = False, donate: bool = False) -> tuple:
     """Executable-cache key of a cohort-batched step body: the member
-    program's identity plus the stacked leading-axis width (the only
-    extra dimension the batched trace depends on — occupancy churn at a
-    held width re-dispatches, never retraces)."""
-    return ("ensemble.step", spec.kind, spec.kernel_key, int(width))
+    program's identity plus everything else the batched trace (or its
+    buffer-aliasing contract) depends on — the stacked leading-axis
+    width, the dispatch depth k (the ``fori_loop`` trip count is
+    static, so each depth is one compile: changing ONLY k at a held
+    (signature, width) costs exactly one new body), whether the
+    runtime-argument tables are broadcast-shared (vmap ``in_axes=None``
+    — a different traced program from the per-member stack) and whether
+    the stacked state is donated.  Occupancy churn at a held key
+    re-dispatches, never retraces."""
+    k = int(spec.steps_per_dispatch if steps_per_dispatch is None
+            else steps_per_dispatch)
+    return ("ensemble.step", spec.kind, spec.kernel_key, int(width),
+            max(k, 1), bool(shared_args), bool(donate))
 
 
 def mesh_key(mesh):

@@ -36,7 +36,33 @@ from .exec_cache import ExecutableCache, mesh_key as _mesh_key, traced_jit
 from .mesh import SHARD_AXIS, put_table
 from .shapes import bucket_pairs
 
-__all__ = ["HaloExchange", "HaloHandle"]
+__all__ = ["HaloExchange", "HaloHandle", "interior_steps_per_exchange"]
+
+
+def interior_steps_per_exchange(ghost_depth: int,
+                                stencil_radius: int = 1) -> int:
+    """Deep-dispatch budget of one boundary sync (ISSUE 11): how many
+    interior updates a ghost zone ``ghost_depth`` cells deep can serve
+    before a stencil of ``stencil_radius`` has consumed it — the source
+    paper's distance-k neighborhood premise (dccrg supports rings at
+    any hood length precisely so a deeper exchange can amortize more
+    local work).  Each update invalidates the outermost
+    ``stencil_radius`` shells of the ghost zone, so the budget is
+    ``ghost_depth // stencil_radius`` (floor 1: a zero-depth hood still
+    supports its one face-coupled update, which is how the repo's
+    nbh-length-0 workloads step today).
+
+    The serving tier's fused k-step cohort bodies currently re-exchange
+    inside the loop each interior step — correct at ANY k, since the
+    in-kernel protocol equals k solo steps — so this budget is the
+    PLANNING bound for the follow-on that hoists one depth-k exchange
+    above the loop.  On jax 0.4.x the hoisted form must keep the DMA
+    start/wait split at program level (semaphore outputs across
+    ``pallas_call`` boundaries are unimplemented — see PR 7's notes),
+    exactly like the split-phase steps do."""
+    depth = max(int(ghost_depth), 0)
+    radius = max(int(stencil_radius), 1)
+    return max(depth // radius, 1)
 
 #: process-wide fallback cache for exchanges constructed without a grid
 #: (tests, ad-hoc schedules) — grid-owned exchanges share the grid's own
@@ -310,6 +336,13 @@ class HaloExchange:
             with jax.named_scope(f"halo.ring.r{i}.finish"):
                 blk = blk.at[rr].set(p)
         return blk
+
+    @property
+    def ring_distances(self) -> tuple:
+        """The ring distances this schedule actually ships (ascending)
+        — the per-ring-distance schedule surface deep dispatch plans
+        against (:func:`interior_steps_per_exchange`)."""
+        return tuple(self.ring_ks)
 
     @property
     def structure_key(self) -> tuple:

@@ -17,6 +17,8 @@ from .ensemble import (
     Scenario,
     Scheduler,
     cohort_width,
+    donation_enabled,
+    shared_tables_enabled,
     verify_enabled,
 )
 
@@ -26,5 +28,7 @@ __all__ = [
     "Scenario",
     "Scheduler",
     "cohort_width",
+    "donation_enabled",
+    "shared_tables_enabled",
     "verify_enabled",
 ]

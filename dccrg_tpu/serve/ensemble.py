@@ -65,12 +65,57 @@ fleet.  This module is the front-end that exploits it:
   ``DCCRG_SLO_QUEUE_S`` / ``DCCRG_SLO_E2E_S`` (seconds) count
   ``ensemble.slo_violations{class=queue_wait|e2e}`` when exceeded.
 
+* **Deep dispatch** (ISSUE 11): the hot loop pays one host dispatch
+  per **k** simulation steps, not per step.  The member ``call`` is
+  wrapped in a ``lax.fori_loop`` stepping k interior steps inside the
+  one vmapped jitted cohort body (the split-phase halo structure stays
+  at PROGRAM level — jax 0.4.x cannot split DMA start/wait across
+  ``pallas_call`` boundaries, so each interior step's exchange starts
+  and completes inside the loop body, exactly as the member program
+  does solo).  k is static per compiled body (``cohort_key`` carries
+  it — changing only k at a held (signature, width) compiles exactly
+  one new body); per-member ``remaining`` budgets ride along as a
+  runtime argument so the occupancy mask freezes a member mid-k-block
+  the moment its budget is spent, the same way it freezes exhausted
+  slots mid-stack.  The scheduler picks k per dispatch
+  (:meth:`Scheduler.select_k`) from the configured depth
+  (``DCCRG_ENSEMBLE_K``, capped by ``DCCRG_ENSEMBLE_K_MAX``), clamped
+  to the deepest step any active member can still use and to the
+  earliest member deadline's slack (a tight deadline must not wait out
+  a 16-step block it only needed 2 steps of).
+
+* **Buffer donation**: the stacked cohort state is donated to the step
+  body (``donate_argnums`` — the jit aliases input and output buffers)
+  so XLA stops materializing a second copy of the fleet state every
+  dispatch: the steady-state HBM cost per cohort drops from ~2x state
+  to ~1x and the copy disappears from the dispatch path.  Backends
+  without donation (CPU) fall back to copying with a one-time jax
+  warning; ``DCCRG_ENSEMBLE_DONATE=0`` opts out.  The solo-replay
+  oracle snapshots its sampled member's row BEFORE the dispatch — a
+  donated input buffer must never be read after the call.
+
+* **Broadcast-shared tables** (the PR 9 follow-on): members of one
+  model instance carry byte-identical runtime-argument tables, and the
+  pre-ISSUE-11 cohort stacked W copies of them.  A cohort now starts
+  in shared mode — ONE broadcast copy of the tables, vmap
+  ``in_axes=None`` — and admission content-checks each joiner's tables
+  against the shared copy (object identity first, byte compare once on
+  mismatch); a joiner with genuinely different tables promotes the
+  cohort to the per-member stack (one new compile, like width growth,
+  counted ``ensemble.cohort_promotions``).  Per-member HBM falls by
+  ~``tables x (W-1)/W`` for the homogeneous cohorts that dominate
+  parameter sweeps — measured by the
+  ``ensemble.hbm_bytes_per_member{model}`` gauge (``obs/hbm.py``),
+  which ``tools/telemetry_diff.py`` ceiling-gates.
+
 Correctness anchor: a cohort-stepped scenario is **bit-identical** to
 the same member stepped solo through its own model kernel (vmap batches
-the member program without reassociating its arithmetic).  The
-always-available oracle — ``DCCRG_ENSEMBLE_VERIFY=1``, or
-``Ensemble(verify=True)`` — replays one sampled active member solo per
-cohort step and byte-compares every field; mismatches are COUNTED
+the member program without reassociating its arithmetic; a depth-k
+dispatch must match k solo steps).  The always-available oracle —
+``DCCRG_ENSEMBLE_VERIFY=1``, or ``Ensemble(verify=True)`` — replays
+one sampled active member solo per cohort dispatch (k solo steps for a
+depth-k dispatch, clamped to the member's own advance) and
+byte-compares every field; mismatches are COUNTED
 (``ensemble.verify_mismatches{field}`` under the ``ensemble.verify``
 phase), never raised, mirroring the halo/epoch oracle protocol.
 """
@@ -85,9 +130,15 @@ import numpy as np
 
 from ..obs.events import timeline
 from ..obs.flightrec import recorder as flightrec
+from ..obs.hbm import sample_ensemble_hbm
 from ..obs.registry import metrics
 from ..obs.slo import SLO_RESOLUTION
-from ..parallel.exec_cache import BatchStepSpec, cohort_key, traced_jit
+from ..parallel.exec_cache import (
+    BatchStepSpec,
+    cohort_key,
+    max_steps_per_dispatch,
+    traced_jit,
+)
 from ..parallel.mesh import SHARD_AXIS
 
 # the request-latency series resolve finer than the octave default so
@@ -104,6 +155,8 @@ __all__ = [
     "Ensemble",
     "cohort_width",
     "verify_enabled",
+    "donation_enabled",
+    "shared_tables_enabled",
 ]
 
 
@@ -111,6 +164,24 @@ def verify_enabled() -> bool:
     """Whether the solo-replay oracle is armed process-wide
     (``DCCRG_ENSEMBLE_VERIFY=1``)."""
     return os.environ.get("DCCRG_ENSEMBLE_VERIFY", "0") == "1"
+
+
+def donation_enabled() -> bool:
+    """Whether cohort step bodies donate the stacked state
+    (``DCCRG_ENSEMBLE_DONATE``, default on).  Donation aliases the
+    input and output buffers so a dispatch stops costing a second copy
+    of the fleet state; backends without donation support copy as
+    before (jax warns once per body)."""
+    return os.environ.get("DCCRG_ENSEMBLE_DONATE", "1") != "0"
+
+
+def shared_tables_enabled() -> bool:
+    """Whether cohorts start with ONE broadcast-shared copy of the
+    runtime-argument tables instead of a per-member stack
+    (``DCCRG_ENSEMBLE_SHARED``, default on).  Heterogeneous-table
+    members still work: admission promotes the cohort to the stacked
+    form when a joiner's tables differ by content."""
+    return os.environ.get("DCCRG_ENSEMBLE_SHARED", "1") != "0"
 
 
 def _slo_target(name: str) -> float | None:
@@ -216,7 +287,8 @@ class Cohort:
     into a free slot; retirement slices its final state out; neither
     touches the compiled program."""
 
-    def __init__(self, scenario: Scenario, width: int | None = None):
+    def __init__(self, scenario: Scenario, width: int | None = None,
+                 shared: bool | None = None, k: int | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -233,27 +305,57 @@ class Cohort:
         self.dt_dtype = np.dtype(spec.dt_dtype
                                  if spec.dt_dtype is not None
                                  else np.float32)
+        #: default dispatch depth: how many interior steps one host
+        #: dispatch advances unless the scheduler picks otherwise
+        self.k = max(int(k if k is not None
+                         else spec.steps_per_dispatch), 1)
+        self._donate = donation_enabled()
+        #: None until the first donated dispatch MEASURES whether the
+        #: backend actually aliased the buffers (CPU does not — jax
+        #: warns and copies); feeds the in-flight factor of the
+        #: per-member HBM gauge
+        self._donate_effective: bool | None = None
         self.members: list = [None] * self.W
         self._remaining = np.zeros(self.W, np.int64)
         self._occupied = np.zeros(self.W, bool)
         self._dts = np.zeros(self.W, self.dt_dtype)
-        # stacked runtime arguments and state: slot 0's values replicated
-        # as padding (pad slots are masked, their contents only need to
-        # be shape-compatible and finite)
-        self._args = jax.tree_util.tree_map(
-            lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
-            spec.args,
-        )
+        #: the template member's runtime tables, kept as submitted
+        #: (host refs): the content key joiners are checked against in
+        #: shared mode, and the stacking source on promotion
+        self._args_src = spec.args
+        self.shared_args = (shared_tables_enabled() if shared is None
+                            else bool(shared))
+        if self.shared_args:
+            # ONE broadcast copy of the tables (vmap in_axes=None):
+            # members of one model instance carry byte-identical
+            # tables, so stacking W copies only burned HBM
+            self._args = jax.tree_util.tree_map(
+                lambda x: self._put_member(jnp.asarray(x)), spec.args,
+            )
+        else:
+            self._args = jax.tree_util.tree_map(
+                lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
+                spec.args,
+            )
+        # stacked state: slot 0's values replicated as padding (pad
+        # slots are masked, their contents only need to be
+        # shape-compatible and finite)
         self._state = jax.tree_util.tree_map(
             lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
             scenario.state,
         )
-        self._kernel = self._build_kernel()
+        #: compiled bodies by dispatch depth (all ride the grid's
+        #: executable cache; this dict only skips the cache lookup)
+        self._kernels: dict = {}
         self._verify_rr = 0
+        #: EMA of wall seconds per interior step (dispatch-side), the
+        #: service-time estimate deadline-slack k selection divides by
+        self.step_s_ema: float | None = None
         #: highest occupied fraction this cohort ever reached — the
         #: monotone series the telemetry floor gate watches (live
         #: occupancy legitimately returns to 0 after retirement)
         self.peak_occupancy = 0.0
+        self._sample_hbm()
 
     # ------------------------------------------------------------ device
 
@@ -272,33 +374,176 @@ class Cohort:
         except Exception:  # noqa: BLE001 — fall back to default placement
             return stacked
 
-    def _build_kernel(self):
+    def _put_member(self, leaf):
+        """Shard ONE member's (unstacked) table on the device axis
+        (axis 0 for the ``[D, ...]`` epoch tables); leaves without a
+        device axis stay replicated — like :meth:`_put`, a layout hint
+        the jit re-lands as its program requires."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if leaf.ndim < 1:
+            return leaf
+        try:
+            spec = P(SHARD_AXIS, *([None] * (leaf.ndim - 1)))
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+        except Exception:  # noqa: BLE001 — fall back to default placement
+            return leaf
+
+    def _kernel_for(self, k: int):
+        """The compiled depth-``k`` cohort body, via the grid's
+        executable cache: one body per (kernel_key, W, k, shared,
+        donate) — occupancy churn at a held key re-dispatches, a new
+        depth compiles exactly one new body."""
+        k = max(int(k), 1)
+        kern = self._kernels.get(k)
+        if kern is None:
+            kern = self.exec_cache.get(
+                cohort_key(self.spec, self.W, k, self.shared_args,
+                           self._donate),
+                lambda: self._build_kernel(k),
+            )
+            self._kernels[k] = kern
+        return kern
+
+    def _build_kernel(self, k: int):
         """The compiled cohort body: vmap of the member program over the
-        stacked leading axis, inactive slots frozen by the runtime
-        occupancy mask.  Cached under ``(kernel_key, W)`` — the only
-        dimensions the batched trace depends on — so admission and
-        retirement at a held width re-dispatch this executable."""
+        stacked leading axis (tables broadcast via ``in_axes=None`` in
+        shared mode), inactive slots frozen by the runtime occupancy
+        mask.  Depth k > 1 wraps the vmapped step in a ``lax.fori_loop``
+        — k interior steps per host dispatch — with the per-member
+        ``remaining`` budgets clamping each slot mid-loop the moment
+        its budget is spent (``mask & (remaining > i)``): no member
+        ever overshoots its requested steps.  The stacked state is
+        donated (when enabled) so the dispatch aliases instead of
+        copying it; ``remaining``/``dts``/``mask`` are runtime
+        arguments, so neither budgets nor occupancy ever retrace."""
         import jax
         import jax.numpy as jnp
 
         spec = self.spec
         call = spec.call
+        in_axes = (None, 0, 0) if self.shared_args else (0, 0, 0)
+        donate = (1,) if self._donate else ()
 
-        def build():
-            def cohort_step(args, state, dts, mask):
-                stepped = jax.vmap(call, in_axes=(0, 0, 0))(
-                    args, state, dts
-                )
+        def freeze_tree(live, new, old):
+            def freeze(n, o):
+                m = live.reshape(live.shape + (1,) * (n.ndim - 1))
+                return jnp.where(m, n, o)
 
-                def freeze(new, old):
-                    m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
-                    return jnp.where(m, new, old)
+            return jax.tree_util.tree_map(freeze, new, old)
 
-                return jax.tree_util.tree_map(freeze, stepped, state)
+        if k == 1:
+            def cohort_step(args, state, remaining, dts, mask):
+                stepped = jax.vmap(call, in_axes=in_axes)(args, state,
+                                                          dts)
+                return freeze_tree(mask, stepped, state)
+        else:
+            def cohort_step(args, state, remaining, dts, mask):
+                def one(i, st):
+                    stepped = jax.vmap(call, in_axes=in_axes)(args, st,
+                                                              dts)
+                    return freeze_tree(mask & (remaining > i), stepped,
+                                       st)
 
-            return traced_jit(f"ensemble.step.{spec.kind}", cohort_step)
+                return jax.lax.fori_loop(0, k, one, state)
 
-        return self.exec_cache.get(cohort_key(spec, self.W), build)
+        return traced_jit(f"ensemble.step.{spec.kind}", cohort_step,
+                          donate_argnums=donate)
+
+    # ------------------------------------------------- runtime tables
+
+    def _args_match(self, args) -> bool:
+        """Whether a joiner's runtime tables are content-identical to
+        the shared copy.  Object identity first (members of one model
+        instance hand the SAME table arrays to every spec — free);
+        byte compare once otherwise (one admission-time host pass, only
+        for cross-instance joiners)."""
+        import jax
+
+        a = jax.tree_util.tree_leaves(self._args_src)
+        b = jax.tree_util.tree_leaves(args)
+        if len(a) != len(b):
+            return False
+        for x, y in zip(a, b):
+            if x is y:
+                continue
+            xv, yv = np.asarray(x), np.asarray(y)
+            if (xv.shape != yv.shape or xv.dtype != yv.dtype
+                    or not np.array_equal(xv, yv)):
+                return False
+        return True
+
+    def promote_to_stacked(self) -> None:
+        """Re-land the broadcast-shared tables as a per-member ``[W,
+        ...]`` stack so a joiner with genuinely different tables can
+        occupy a slot.  Every current member shares the (verified
+        content-identical) template tables, so stacking the template is
+        loss-free; state rows are untouched.  Costs exactly one new
+        cohort body per depth used afterwards (counted
+        ``ensemble.cohort_promotions``), like width growth."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.shared_args:
+            return
+        self._args = jax.tree_util.tree_map(
+            lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
+            self._args_src,
+        )
+        self.shared_args = False
+        self._kernels = {}
+        metrics.inc("ensemble.cohort_promotions")
+        self._member_bytes_cache = None
+        self._sample_hbm()
+
+    # --------------------------------------------------------- memory
+
+    def member_hbm_bytes(self, in_flight: bool | None = None) -> int:
+        """Measured device bytes per member: unique table buffers
+        (shared tables count ONCE) plus the stacked state, divided by
+        the width.  ``in_flight`` prices the dispatch-time state copy —
+        2x state without effective donation, 1x with (measured, not
+        assumed: the first donated dispatch checks whether the backend
+        really invalidated the input buffers)."""
+        cached = getattr(self, "_member_bytes_cache", None)
+        if cached is None:
+            import jax
+
+            seen: set = set()
+            args_b = 0
+            for leaf in jax.tree_util.tree_leaves(self._args):
+                if id(leaf) in seen:
+                    continue
+                seen.add(id(leaf))
+                args_b += int(getattr(leaf, "nbytes", 0))
+            state_b = sum(int(getattr(x, "nbytes", 0))
+                          for x in jax.tree_util.tree_leaves(self._state))
+            cached = self._member_bytes_cache = (args_b, state_b)
+        args_b, state_b = cached
+        factor = 1 if (in_flight is False or self._donate_effective) \
+            else 2
+        return int((args_b + state_b * factor) / max(self.W, 1))
+
+    def member_hbm_bytes_stacked_tables(self) -> int:
+        """What the pre-ISSUE-11 layout would hold per member: the
+        template tables stacked W times (so per-member table cost is
+        the FULL table set) plus the undonated double-buffered state —
+        the baseline the shared-table + donation win is measured
+        against."""
+        import jax
+
+        args_b = sum(int(np.asarray(x).nbytes)
+                     for x in jax.tree_util.tree_leaves(self._args_src))
+        cached = getattr(self, "_member_bytes_cache", None)
+        if cached is None:
+            self.member_hbm_bytes()
+            cached = self._member_bytes_cache
+        _args, state_b = cached
+        return int(args_b + state_b * 2 / max(self.W, 1))
+
+    def _sample_hbm(self) -> None:
+        sample_ensemble_hbm(self.spec.kind, self.member_hbm_bytes())
 
     # -------------------------------------------------------- membership
 
@@ -316,23 +561,29 @@ class Cohort:
         return int(self._occupied.sum())
 
     def admit(self, scenario: Scenario, slot: int) -> None:
-        """Write one member into ``slot``: its runtime tables, state and
-        dt land in the stacked arrays; shapes never change, so nothing
-        retraces."""
+        """Write one member into ``slot``: its state and dt land in the
+        stacked arrays; its runtime tables land in the stack too
+        (stacked mode) or are content-verified against the one
+        broadcast copy (shared mode — a genuinely different joiner
+        first promotes the cohort to the stack).  Shapes never change,
+        so nothing retraces."""
         import jax
 
         slot = int(slot)
         if self._occupied[slot]:
             raise ValueError(f"slot {slot} already occupied")
+        if self.shared_args and not self._args_match(scenario.spec.args):
+            self.promote_to_stacked()
         self.members[slot] = scenario
         self._occupied[slot] = True
         self._remaining[slot] = scenario.remaining
         self._dts[slot] = (self.dt_dtype.type(scenario.dt)
                            if scenario.dt is not None else 0)
         set_slot = lambda S, x: S.at[slot].set(x)
-        self._args = jax.tree_util.tree_map(
-            set_slot, self._args, scenario.spec.args
-        )
+        if not self.shared_args:
+            self._args = jax.tree_util.tree_map(
+                set_slot, self._args, scenario.spec.args
+            )
         self._state = jax.tree_util.tree_map(
             set_slot, self._state, scenario.state
         )
@@ -377,20 +628,42 @@ class Cohort:
     def active_mask(self) -> np.ndarray:
         return self._occupied & (self._remaining > 0)
 
-    def step(self) -> int:
-        """One cohort step: every occupied slot with remaining work
-        advances by its own dt inside the single compiled dispatch;
-        inactive and exhausted slots are frozen by the mask.  Returns
-        how many members stepped."""
+    def step(self, k: int | None = None) -> int:
+        """One cohort dispatch advancing every occupied slot with
+        remaining work by up to ``k`` interior steps (default: the
+        cohort's configured depth) of its own dt, inside the single
+        compiled program; inactive, exhausted and mid-k-exhausted slots
+        are frozen by the mask + per-member remaining budgets.  Returns
+        total member-steps served (``n_members`` at k=1, as before)."""
+        import jax
         import jax.numpy as jnp
 
         mask = self.active_mask()
         n = int(mask.sum())
         if n == 0:
             return 0
-        pre = self._state if self._verify_active() else None
+        k = self.k if k is None else max(int(k), 1)
+        kernel = self._kernel_for(k)
+        #: per-member steps this dispatch really advances (the in-loop
+        #: clamp mirrors this on device)
+        advanced = np.where(mask, np.minimum(self._remaining, k), 0)
+        # the solo-replay oracle samples its member BEFORE the dispatch:
+        # under donation the stacked input buffers alias into the output
+        # and must never be read after the call
+        verify_slot = pre_member = None
+        if self._verify_active():
+            slots = np.flatnonzero(mask)
+            verify_slot = int(slots[self._verify_rr % len(slots)])
+            self._verify_rr += 1
+            pre_member = self.member_state(verify_slot)
+        donated_probe = (
+            jax.tree_util.tree_leaves(self._state)[0]
+            if self._donate and self._donate_effective is None else None
+        )
         dts = jnp.asarray(self._dts)
         mdev = jnp.asarray(mask)
+        rdev = jnp.asarray(
+            np.where(mask, self._remaining, 0).astype(np.int32))
         t0 = time.perf_counter()
         # the cohort context rides every span the dispatch completes, so
         # a trace attributes each ensemble.step to its cohort; the
@@ -398,34 +671,57 @@ class Cohort:
         # served (truncated — one span per DISPATCH, not per member)
         with timeline.context(cohort=self.sig_label, width=self.W):
             with metrics.phase("ensemble.step"):
-                self._state = self._kernel(self._args, self._state,
-                                           dts, mdev)
+                self._state = kernel(self._args, self._state, rdev,
+                                     dts, mdev)
+        dt_wall = time.perf_counter() - t0
+        if donated_probe is not None:
+            # measured donation effectiveness: a really-donated input
+            # buffer is invalidated at dispatch (CPU backends copy
+            # instead); feeds the in-flight factor of the HBM gauge
+            try:
+                self._donate_effective = bool(donated_probe.is_deleted())
+            except Exception:  # noqa: BLE001 — no such API: assume copy
+                self._donate_effective = False
         if timeline.enabled or flightrec.enabled:
-            dt_span = time.perf_counter() - t0
             args = {
                 "cohort": self.sig_label, "members": n,
+                # k-aware span accounting (ISSUE 11): one span still
+                # covers one DISPATCH, but SLO service-time math needs
+                # to know how many simulation steps it advanced
+                "steps_per_dispatch": k,
+                "member_steps": int(advanced.sum()),
                 "requests": [self.members[s].id
                              for s in np.flatnonzero(mask)[:8]],
             }
-            timeline.add("request.step", t0, dt_span, args)
-            flightrec.add_span("request.step", t0, dt_span, args)
-        self._remaining[mask] -= 1
+            timeline.add("request.step", t0, dt_wall, args)
+            flightrec.add_span("request.step", t0, dt_wall, args)
+        self._remaining -= advanced
+        # dispatch-side per-interior-step wall time EMA: the service
+        # estimate deadline-slack k selection divides by
+        per_step = dt_wall / k
+        self.step_s_ema = (per_step if self.step_s_ema is None
+                           else 0.5 * self.step_s_ema + 0.5 * per_step)
         if metrics.enabled:
             served: dict = {}
             for slot in np.flatnonzero(mask):
                 scn = self.members[slot]
-                scn.steps_done += 1
-                served[scn.tenant] = served.get(scn.tenant, 0) + 1
+                adv = int(advanced[slot])
+                scn.steps_done += adv
+                served[scn.tenant] = served.get(scn.tenant, 0) + adv
             metrics.inc_many([
                 ("ensemble.steps_served", v, {"tenant": t})
                 for t, v in served.items()
             ])
+            metrics.gauge("ensemble.steps_per_dispatch", k,
+                          model=self.spec.kind)
+            self._sample_hbm()
         else:
             for slot in np.flatnonzero(mask):
-                self.members[slot].steps_done += 1
-        if pre is not None:
-            self._verify(pre, mask)
-        return n
+                self.members[slot].steps_done += int(advanced[slot])
+        if verify_slot is not None:
+            self._verify(pre_member, verify_slot,
+                         int(advanced[verify_slot]))
+        return int(advanced.sum())
 
     # ------------------------------------------------------------ oracle
 
@@ -433,26 +729,26 @@ class Cohort:
         return self._verify_on if hasattr(self, "_verify_on") \
             else verify_enabled()
 
-    def _verify(self, pre_state, mask: np.ndarray) -> int:
-        """Replay ONE sampled active member solo through its own member
-        program (the model's cached step kernel — the always-available
-        oracle) and byte-compare every field of its cohort row.
-        Mismatches are counted, never raised; the sample rotates
-        round-robin over active slots so every member is eventually
-        audited.  Returns the mismatch count (tests read it)."""
+    def _verify(self, member_pre, slot: int, nsteps: int) -> int:
+        """Replay the pre-sampled member ``nsteps`` solo steps through
+        its own member program (the model's cached step kernel — the
+        always-available oracle; ``nsteps`` is the member's real
+        advance this dispatch, so a depth-k block is audited as k solo
+        steps and a mid-k-retired member as its clamped count) and
+        byte-compare every field of its cohort row.  Mismatches are
+        counted, never raised; the sample rotates round-robin over
+        active slots so every member is eventually audited.  Returns
+        the mismatch count (tests read it)."""
         import jax
 
-        slots = np.flatnonzero(mask)
-        if len(slots) == 0:
-            return 0
         t0 = time.perf_counter()
-        slot = int(slots[self._verify_rr % len(slots)])
-        self._verify_rr += 1
         take = lambda S: S[slot]
-        member_pre = jax.tree_util.tree_map(take, pre_state)
-        member_args = jax.tree_util.tree_map(take, self._args)
+        member_args = (self._args if self.shared_args
+                       else jax.tree_util.tree_map(take, self._args))
         dt = self.dt_dtype.type(self._dts[slot])
-        solo = self.spec.call(member_args, member_pre, dt)
+        solo = member_pre
+        for _ in range(max(nsteps, 1)):
+            solo = self.spec.call(member_args, solo, dt)
         got = jax.tree_util.tree_map(take, self._state)
         names = sorted(solo) if isinstance(solo, dict) else None
         solo_l = jax.tree_util.tree_leaves(solo)
@@ -494,7 +790,8 @@ class Scheduler:
     def __init__(self, policy: str = "round_robin",
                  max_width: int | None = None,
                  max_cohorts: int | None = None,
-                 verify: bool | None = None):
+                 verify: bool | None = None,
+                 steps_per_dispatch: int | None = None):
         if policy not in ("round_robin", "deadline"):
             raise ValueError(f"unknown scheduling policy {policy!r}")
         self.policy = policy
@@ -502,6 +799,11 @@ class Scheduler:
                           else _env_int("DCCRG_ENSEMBLE_MAX_COHORT", 1024))
         self.max_cohorts = max_cohorts
         self.verify = verify
+        #: deep-dispatch depth override; None defers to each cohort's
+        #: spec default (DCCRG_ENSEMBLE_K via the model providers)
+        self.steps_per_dispatch = (
+            max(int(steps_per_dispatch), 1)
+            if steps_per_dispatch is not None else None)
         self._queue: deque = deque()
         self.cohorts: dict = {}
         self._rr = 0
@@ -574,7 +876,8 @@ class Scheduler:
         template = members[0][1] if members else None
         if template is None:
             return cohort
-        fresh = Cohort(template, width=new_w)
+        fresh = Cohort(template, width=new_w, shared=cohort.shared_args,
+                       k=cohort.k)
         if self.verify is not None:
             fresh._verify_on = self.verify
         for new_slot, (old_slot, scn) in enumerate(members):
@@ -618,7 +921,8 @@ class Scheduler:
                         self._width_hints.get(key),
                     )
                     self._width_hints[key] = width
-                    cohort = Cohort(scn, width=width)
+                    cohort = Cohort(scn, width=width,
+                                    k=self.steps_per_dispatch)
                     if self.verify is not None:
                         cohort._verify_on = self.verify
                     self.cohorts[key] = cohort
@@ -700,13 +1004,43 @@ class Scheduler:
         k = self._rr % len(live)
         return live[k:] + live[:k]
 
+    def select_k(self, cohort: Cohort, now: float | None = None) -> int:
+        """Dispatch depth for this cohort's next step (ISSUE 11): the
+        configured depth (scheduler override, else the cohort's spec
+        default), clamped three ways —
+
+        * to ``DCCRG_ENSEMBLE_K_MAX`` (compile-cache cardinality);
+        * to the deepest step any active member can still USE
+          (``max(remaining)`` — the in-kernel budgets already stop each
+          member overshooting, this clamp stops the loop burning frozen
+          iterations every member would discard);
+        * to the earliest member deadline's slack over the cohort's
+          measured per-step time EMA (a tight-deadline member must not
+          sit out a deep block it only needed the first steps of —
+          depth trades dispatch overhead against retirement latency,
+          and slack is the budget for that trade).
+        """
+        k = (self.steps_per_dispatch
+             if self.steps_per_dispatch is not None else cohort.k)
+        k = max(1, min(int(k), max_steps_per_dispatch()))
+        active = cohort.active_mask()
+        if active.any():
+            k = min(k, int(cohort._remaining[active].max()))
+        deadline = cohort.min_deadline()
+        ema = cohort.step_s_ema
+        if deadline != float("inf") and ema and ema > 0:
+            now = time.perf_counter() if now is None else now
+            slack = deadline - now
+            k = 1 if slack <= 0 else min(k, max(1, int(slack / ema)))
+        return max(k, 1)
+
     def step_once(self) -> int:
         """One scheduling tick: step every cohort with active members
-        (policy order), then retire finished members.  Returns total
-        member-steps served."""
+        (policy order) at its selected dispatch depth, then retire
+        finished members.  Returns total member-steps served."""
         served = 0
         for cohort in self._ordered_cohorts():
-            served += cohort.step()
+            served += cohort.step(self.select_k(cohort))
             for slot in cohort.finished_slots():
                 scn = cohort.retire(int(slot))
                 self.completed.append(scn)
@@ -783,14 +1117,19 @@ class Ensemble:
 
     ``verify=True`` (or ``DCCRG_ENSEMBLE_VERIFY=1``) arms the
     solo-replay oracle; ``policy="deadline"`` steps cohorts by earliest
-    member deadline instead of round-robin."""
+    member deadline instead of round-robin; ``steps_per_dispatch=k``
+    makes every scheduling tick advance cohorts k simulation steps per
+    host dispatch (deep dispatch — default is each model's
+    ``DCCRG_ENSEMBLE_K`` spec depth)."""
 
     def __init__(self, policy: str = "round_robin",
                  max_width: int | None = None,
                  max_cohorts: int | None = None,
-                 verify: bool | None = None):
+                 verify: bool | None = None,
+                 steps_per_dispatch: int | None = None):
         self.scheduler = Scheduler(policy=policy, max_width=max_width,
-                                   max_cohorts=max_cohorts, verify=verify)
+                                   max_cohorts=max_cohorts, verify=verify,
+                                   steps_per_dispatch=steps_per_dispatch)
 
     def submit(self, model, state, steps: int, dt=None,
                tenant: str = "default",

@@ -1,0 +1,453 @@
+"""Deep dispatch (ISSUE 11): k-steps-per-call cohort bodies, buffer
+donation and broadcast-shared tables.
+
+The contracts under test: a depth-k dispatch is bit-identical to k solo
+steps (through the solo-replay oracle, including members retiring
+mid-k-block and heterogeneous-grid cohorts); occupancy churn at a held
+(signature, width, k) retraces nothing and changing ONLY k compiles
+exactly one new body; donating the stacked state never corrupts a
+member the oracle replays; the scheduler's k selection clamps to
+per-member remaining budgets and to deadline slack; the per-member HBM
+gauge measures the shared-table win and the ``telemetry_diff`` ceiling
+gate watches it; ``request.step`` spans and ``ensemble.steps_served``
+stay exact when one dispatch advances k steps."""
+import numpy as np
+import pytest
+
+import jax
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.models import Advection, GameOfLife
+from dccrg_tpu.obs.events import timeline
+from dccrg_tpu.parallel.exec_cache import (
+    BatchStepSpec,
+    cohort_key,
+    default_steps_per_dispatch,
+    max_steps_per_dispatch,
+)
+from dccrg_tpu.parallel.halo import interior_steps_per_exchange
+from dccrg_tpu.serve import Ensemble, Scenario, Scheduler
+
+
+def make_grid(n=4, n_dev=None, max_ref=0, refine_seed=None):
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_maximum_refinement_level(max_ref)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=(1.0 / n,) * 3)
+        .initialize(mesh=make_mesh(n_devices=n_dev))
+    )
+    if refine_seed is not None:
+        rng = np.random.default_rng(refine_seed)
+        ids = np.sort(g.get_cells())
+        for cid in rng.choice(ids, size=max(1, len(ids) // 6),
+                              replace=False):
+            g.refine_completely(int(cid))
+    g.stop_refining()
+    return g
+
+
+def gol_states(gol, g, count, seed=0):
+    rng = np.random.default_rng(seed)
+    cells = g.get_cells()
+    return [
+        gol.new_state(alive_cells=cells[rng.random(len(cells)) < 0.3])
+        for _ in range(count)
+    ]
+
+
+def tree_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def counter_total(name: str) -> int:
+    rep = obs.metrics.report()
+    return int(sum(rep["counters"].get(name, {}).values()))
+
+
+# --------------------------------------------- k-step bit-identity
+
+
+def test_k4_gol_bit_identical_incl_mid_k_retirement():
+    """A depth-4 dispatch equals 4 solo steps for every member — and a
+    member whose budget is NOT a multiple of k freezes mid-k-block at
+    exactly its budget (here 6 = 4 + 2 inside the second block)."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 4, seed=1)
+    c0 = counter_total("ensemble.verify_checks")
+    m0 = counter_total("ensemble.verify_mismatches")
+    ens = Ensemble(verify=True, steps_per_dispatch=4)
+    budgets = [6, 8, 8, 8]                  # member 0 retires mid-block
+    tickets = [ens.submit(gol, s, steps=b)
+               for s, b in zip(states, budgets)]
+    ens.run()
+    for t, s0, b in zip(tickets, states, budgets):
+        assert t.status == "done" and t.steps_done == b
+        ref = s0
+        for _ in range(b):
+            ref = gol.step(ref)
+        assert tree_equal(ref, t.result)
+    assert counter_total("ensemble.verify_checks") > c0
+    assert counter_total("ensemble.verify_mismatches") == m0
+
+
+def test_advection_f64_heterogeneous_cohort_k_steps_bit_identical():
+    """Two refined grids sharing one signature batch into ONE depth-k
+    cohort; each member's result is bit-identical to its own model
+    stepped solo, with one member's budget landing mid-block."""
+    g1 = make_grid(max_ref=1, refine_seed=3)
+    g2 = make_grid(max_ref=1, refine_seed=3)
+    a1 = Advection(g1, dtype=np.float64, allow_dense=False)
+    a2 = Advection(g2, dtype=np.float64, allow_dense=False)
+    assert g1.shape_signature() == g2.shape_signature()
+    s1, s2 = a1.initialize_state(), a2.initialize_state()
+    dt = 0.4 * a1.max_time_step(s1)
+    ens = Ensemble(verify=True, steps_per_dispatch=3)
+    t1 = ens.submit(a1, s1, steps=5, dt=dt, tenant="a")  # 3 + 2
+    t2 = ens.submit(a2, s2, steps=6, dt=dt, tenant="b")  # 3 + 3
+    ens.run()
+    assert len(ens.cohorts) == 1
+    for ticket, (m, s0, steps) in ((t1, (a1, s1, 5)), (t2, (a2, s2, 6))):
+        ref = s0
+        for _ in range(steps):
+            ref = m.step(ref, dt)
+        np.testing.assert_array_equal(
+            np.asarray(ref["density"]),
+            np.asarray(ticket.result["density"]))
+    assert counter_total("ensemble.verify_mismatches") == 0
+
+
+# --------------------------------------------- compile accounting
+
+
+def test_zero_retrace_churn_at_held_signature_width_and_k():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 12, seed=2)
+    ens = Ensemble(steps_per_dispatch=4)
+    for s in states[:4]:
+        ens.submit(gol, s, steps=8)
+    ens.run()                               # warm the (W=4, k=4) body
+    before = counter_total("epoch.recompiles")
+    for wave in (states[4:8], states[8:10], states[10:12]):
+        for i, s in enumerate(wave):
+            ens.submit(gol, s, steps=4 * (i + 1))
+        ens.run()
+    assert counter_total("epoch.recompiles") == before, (
+        "churn at a held (signature, width, k) must not retrace")
+    assert len(ens.completed) == 12
+
+
+def test_changing_only_k_compiles_exactly_one_body():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 2, seed=3)
+    sched = Scheduler()
+    for s in states:
+        sched.submit(Scenario(gol, s, 64))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    cohort.step(1)                          # warm k=1
+    before = counter_total("epoch.recompiles")
+    cohort.step(4)                          # NEW body: exactly one trace
+    assert counter_total("epoch.recompiles") == before + 1
+    cohort.step(4)                          # held k: re-dispatch
+    cohort.step(1)                          # k=1 body still cached
+    assert counter_total("epoch.recompiles") == before + 1
+    # the cache key really carries k (plus layout flags)
+    spec = cohort.spec
+    assert cohort_key(spec, cohort.W, 1) != cohort_key(spec, cohort.W, 4)
+    assert (cohort_key(spec, cohort.W, 4, shared_args=True)
+            != cohort_key(spec, cohort.W, 4, shared_args=False))
+
+
+# ------------------------------------------------------- donation
+
+
+def test_donation_does_not_corrupt_oracle_replayed_member():
+    """With donation armed (the default), the oracle's pre-dispatch
+    member snapshot must survive the aliasing dispatch: replays stay
+    clean and results stay bit-identical across many dispatches."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 3, seed=4)
+    m0 = counter_total("ensemble.verify_mismatches")
+    ens = Ensemble(verify=True, steps_per_dispatch=2)
+    tickets = [ens.submit(gol, s, steps=8) for s in states]
+    ens.run()
+    cohort = next(iter(ens.cohorts.values()))
+    assert cohort._donate is True          # donation is the default
+    for t, s0 in zip(tickets, states):
+        ref = s0
+        for _ in range(8):
+            ref = gol.step(ref)
+        assert tree_equal(ref, t.result)
+    assert counter_total("ensemble.verify_mismatches") == m0
+
+
+def test_donation_env_gate(monkeypatch):
+    from dccrg_tpu.serve import donation_enabled
+
+    monkeypatch.delenv("DCCRG_ENSEMBLE_DONATE", raising=False)
+    assert donation_enabled()
+    monkeypatch.setenv("DCCRG_ENSEMBLE_DONATE", "0")
+    assert not donation_enabled()
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    ens = Ensemble()
+    t = ens.submit(gol, gol_states(gol, g, 1, seed=5)[0], steps=2)
+    ens.run()
+    cohort = next(iter(ens.cohorts.values()))
+    assert cohort._donate is False
+    ref = gol.step(gol.step(gol_states(gol, g, 1, seed=5)[0]))
+    assert t.status == "done"
+
+
+# ------------------------------------------------------ k selection
+
+
+def test_select_k_clamps_to_remaining_steps():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 2, seed=6)
+    sched = Scheduler(steps_per_dispatch=16)
+    sched.submit(Scenario(gol, states[0], 3))
+    sched.submit(Scenario(gol, states[1], 5))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    # deepest usable step: the LONGEST remaining budget (the shorter
+    # member freezes mid-block via the in-kernel clamp)
+    assert sched.select_k(cohort) == 5
+    while sched.step_once():
+        pass
+    assert all(s.steps_done == s.steps
+               for s in (sched.completed[0], sched.completed[1]))
+
+
+def test_select_k_deadline_slack_and_cap(monkeypatch):
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    state = gol_states(gol, g, 1, seed=7)[0]
+    sched = Scheduler(steps_per_dispatch=16)
+    sched.submit(Scenario(gol, state, 64, deadline=1002.0))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    # no EMA yet: slack cannot be priced, remaining is the only clamp
+    assert sched.select_k(cohort, now=1000.0) == 16
+    cohort.step_s_ema = 1.0
+    # 2 s of slack at 1 s/step affords a depth-2 block, not 16
+    assert sched.select_k(cohort, now=1000.0) == 2
+    # past-deadline member: retire visibility ASAP, depth 1
+    assert sched.select_k(cohort, now=1003.0) == 1
+    # the env cap bounds everything
+    monkeypatch.setenv("DCCRG_ENSEMBLE_K_MAX", "8")
+    cohort.step_s_ema = None
+    assert sched.select_k(cohort, now=1000.0) == 8
+    assert max_steps_per_dispatch() == 8
+
+
+def test_spec_default_k_rides_env(monkeypatch):
+    monkeypatch.delenv("DCCRG_ENSEMBLE_K", raising=False)
+    assert default_steps_per_dispatch() == 1
+    monkeypatch.setenv("DCCRG_ENSEMBLE_K", "4")
+    assert default_steps_per_dispatch() == 4
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    spec = gol.batch_step_spec()
+    assert spec.steps_per_dispatch == 4
+    # the spec default reaches the cohort when no override is given
+    sched = Scheduler()
+    sched.submit(Scenario(gol, gol_states(gol, g, 1, seed=8)[0], 8))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    assert cohort.k == 4 and sched.select_k(cohort) == 4
+    monkeypatch.setenv("DCCRG_ENSEMBLE_K", "not-a-number")
+    assert default_steps_per_dispatch() == 1
+
+
+# --------------------------------------- shared tables + HBM gauge
+
+
+def test_shared_tables_measured_lower_than_stacked_equiv():
+    """Members of one model instance share ONE broadcast table copy:
+    the measured per-member bytes sit far below the stacked-tables
+    equivalent, and the gauge lands for telemetry_diff to ceiling-gate."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    ens = Ensemble()
+    for s in gol_states(gol, g, 4, seed=9):
+        ens.submit(gol, s, steps=2)
+    ens.admit_pending()
+    cohort = next(iter(ens.cohorts.values()))
+    assert cohort.shared_args
+    measured = cohort.member_hbm_bytes()
+    stacked = cohort.member_hbm_bytes_stacked_tables()
+    assert 0 < measured < stacked
+    gauge = obs.metrics.gauge_value("ensemble.hbm_bytes_per_member",
+                                    model="gol")
+    assert gauge == measured
+    ens.run()
+
+
+def test_promotion_to_stacked_is_loss_free_and_counted():
+    """A cohort promoted to per-member stacked tables keeps every
+    member's results bit-identical (one new body compile, counted),
+    and the per-member bytes rise — the regression direction the
+    ceiling gate watches."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 3, seed=10)
+    ens = Ensemble(verify=True)
+    tickets = [ens.submit(gol, s, steps=6) for s in states]
+    ens.admit_pending()
+    cohort = next(iter(ens.cohorts.values()))
+    ens.step()                               # shared-mode dispatches
+    before_bytes = cohort.member_hbm_bytes()
+    p0 = counter_total("ensemble.cohort_promotions")
+    r0 = counter_total("epoch.recompiles")
+    cohort.promote_to_stacked()
+    assert not cohort.shared_args
+    assert counter_total("ensemble.cohort_promotions") == p0 + 1
+    ens.run()                                # stacked-mode dispatches
+    assert counter_total("epoch.recompiles") == r0 + 1, (
+        "promotion must cost exactly the one stacked body")
+    assert cohort.member_hbm_bytes() > before_bytes
+    for t, s0 in zip(tickets, states):
+        ref = s0
+        for _ in range(6):
+            ref = gol.step(ref)
+        assert tree_equal(ref, t.result)
+    assert counter_total("ensemble.verify_mismatches") == 0
+
+
+def test_mismatched_tables_promote_on_admit():
+    """A joiner whose runtime tables differ by CONTENT flips the cohort
+    out of shared mode at admission (the content key), and both members
+    still step to their own solo results."""
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 2, seed=11)
+    sched = Scheduler()
+    a = sched.submit(Scenario(gol, states[0], 3))
+    sched.admit()
+    cohort = next(iter(sched.cohorts.values()))
+    assert cohort.shared_args
+    b = Scenario(gol, states[1], 3)
+    sched.submit(b)
+    # perturb ONE table copy into content-inequality: a fresh tuple of
+    # recreated arrays keeps identity-miss + content-hit on all leaves
+    # except the first, which gets a same-shape different value
+    leaves = [np.asarray(x).copy()
+              for x in jax.tree_util.tree_leaves(b.spec.args)]
+    treedef = jax.tree_util.tree_structure(b.spec.args)
+    leaves[0] = leaves[0] ^ 1 if leaves[0].dtype.kind in "iu" \
+        else leaves[0] + 1
+    b.spec = b.spec._replace(
+        args=jax.tree_util.tree_unflatten(treedef, leaves))
+    sched.admit()
+    # admission grew the width-1 cohort (fresh object) and THEN the
+    # mismatched joiner promoted it out of shared mode
+    cohort = next(iter(sched.cohorts.values()))
+    assert not cohort.shared_args
+    assert cohort.occupancy == 2
+    while sched.step_once():
+        pass
+    # member a is untouched by the promotion: solo-identical
+    ref = states[0]
+    for _ in range(3):
+        ref = gol.step(ref)
+    assert tree_equal(ref, a.result)
+    # member b's result equals ITS member program on ITS (perturbed)
+    # tables — the stacked cohort really used the per-member copy
+    solo = states[1]
+    for _ in range(3):
+        solo = b.spec.call(b.spec.args, solo, np.float32(0))
+    assert tree_equal(solo, b.result)
+
+
+# ------------------------------------------- k-aware SLO accounting
+
+
+def test_request_step_span_and_steps_served_are_k_aware():
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 2, seed=12)
+    t0 = obs.metrics.counter_value("ensemble.steps_served",
+                                   tenant="kaware")
+    ens = Ensemble(steps_per_dispatch=4)
+    for s in states:
+        ens.submit(gol, s, steps=8, tenant="kaware")
+    ens.run()
+    assert obs.metrics.counter_value(
+        "ensemble.steps_served", tenant="kaware") == t0 + 16
+    spans = [s for s in timeline.spans()
+             if s["name"] == "request.step" and s["args"]
+             and s["args"].get("steps_per_dispatch") == 4]
+    assert spans, "depth-4 dispatches must leave k-aware step spans"
+    last = spans[-1]
+    assert last["args"]["member_steps"] == 8      # 2 members x k=4
+    assert last["args"]["members"] == 2
+    k_gauge = obs.metrics.gauge_value("ensemble.steps_per_dispatch",
+                                      model="gol")
+    assert k_gauge == 4
+
+
+# -------------------------------------------------- halo depth budget
+
+
+def test_interior_steps_per_exchange_budget():
+    # ghost depth g, stencil radius r -> floor(g / r), floored at 1
+    assert interior_steps_per_exchange(0) == 1
+    assert interior_steps_per_exchange(1) == 1
+    assert interior_steps_per_exchange(4) == 4
+    assert interior_steps_per_exchange(4, stencil_radius=2) == 2
+    assert interior_steps_per_exchange(5, stencil_radius=2) == 2
+    g = make_grid()
+    ex = g.halo(None)
+    assert ex.ring_distances == tuple(ex.ring_ks)
+
+
+# --------------------------------------------- telemetry ceiling gate
+
+
+def test_telemetry_diff_hbm_ceiling_gate():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_diff",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools" / "telemetry_diff.py",
+    )
+    td = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(td)
+    assert "ensemble.hbm_bytes_per_member" in td.GATED_GAUGES_MAX
+    base = {"ensemble.hbm_bytes_per_member": {"model=gol": 1000}}
+    ok = {"ensemble.hbm_bytes_per_member": {"model=gol": 1100}}
+    bad = {"ensemble.hbm_bytes_per_member": {"model=gol": 2000}}
+    lower = {"ensemble.hbm_bytes_per_member": {"model=gol": 10}}
+    gate = td.compare_gauges(ok, base, threshold=0.35,
+                             gauges=td.GATED_GAUGES_MAX, mode="max")
+    assert gate["verdict"] == "PASS"
+    gate = td.compare_gauges(bad, base, threshold=0.35,
+                             gauges=td.GATED_GAUGES_MAX, mode="max")
+    assert gate["verdict"] == "FAIL"
+    # an IMPROVEMENT (bytes falling) must pass the ceiling...
+    gate = td.compare_gauges(lower, base, threshold=0.35,
+                             gauges=td.GATED_GAUGES_MAX, mode="max")
+    assert gate["verdict"] == "PASS"
+    # ...and a vanished series is still a coverage loss
+    gate = td.compare_gauges({}, base, threshold=0.35,
+                             gauges=td.GATED_GAUGES_MAX, mode="max")
+    assert gate["verdict"] == "FAIL"
+    with pytest.raises(ValueError, match="mode"):
+        td.compare_gauges(ok, base, mode="sideways")

@@ -397,13 +397,13 @@ def test_verify_oracle_detects_tampering():
     ens.submit(gol, gol_states(gol, g, 1, seed=12)[0], steps=2)
     ens.admit_pending()
     cohort = next(iter(ens.cohorts.values()))
-    kernel = cohort._kernel
+    kernel = cohort._kernel_for(1)
 
-    def tampered(args, state, dts, mask):
-        out = kernel(args, state, dts, mask)
+    def tampered(args, state, remaining, dts, mask):
+        out = kernel(args, state, remaining, dts, mask)
         return {**out, "is_alive": out["is_alive"] ^ 1}
 
-    cohort._kernel = tampered
+    cohort._kernels[1] = tampered
     m0 = obs.metrics.counter_value("ensemble.verify_mismatches",
                                    field="is_alive")
     cohort.step()                                # counted, not raised

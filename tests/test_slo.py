@@ -375,11 +375,11 @@ def test_verify_mismatch_dumps_black_box(tmp_path):
         ens.step()
         # corrupt the cohort BODY: its output diverges from the solo
         # member program, which is exactly what the oracle audits
-        kernel = cohort._kernel
-        cohort._kernel = lambda args, state, dts, mask: (
+        kernel = cohort._kernel_for(1)
+        cohort._kernels[1] = lambda args, state, remaining, dts, mask: (
             jax.tree_util.tree_map(
                 lambda S: S + S.dtype.type(1),
-                kernel(args, state, dts, mask),
+                kernel(args, state, remaining, dts, mask),
             )
         )
         ens.step()

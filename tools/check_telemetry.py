@@ -802,6 +802,61 @@ def _ensemble_probe() -> list:
             failures.append(
                 f"ensemble probe: peak occupancy out of (0, 1]: {occ}"
             )
+
+        # deep dispatch (ISSUE 11): a k=4 cohort round — the fori_loop
+        # body must be bit-identical to 4 solo steps (oracle armed), a
+        # second wave at the held (signature, width, k) must recompile
+        # NOTHING, and the depth + per-member HBM gauges must land
+        ens4 = Ensemble(verify=True, steps_per_dispatch=4)
+        deep = [mk() for _ in range(4)]
+        deep_tickets = [ens4.submit(gol, s, steps=8) for s in deep]
+        ens4.run()                               # warms the k=4 body
+        before = recompiles()
+        for s in (mk() for _ in range(4)):       # churn at held (W, k)
+            ens4.submit(gol, s, steps=8)
+        ens4.run()
+        if recompiles() != before:
+            failures.append(
+                f"ensemble probe: k=4 churn at a held (signature, "
+                f"width, k) recompiled {recompiles() - before} "
+                "kernel(s); deep dispatch must re-dispatch the cached "
+                "body"
+            )
+        ref4 = deep[0]
+        for _ in range(8):
+            ref4 = gol.step(ref4)
+        same4 = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(jax.tree_util.tree_leaves(ref4),
+                            jax.tree_util.tree_leaves(
+                                deep_tickets[0].result))
+        )
+        if not same4:
+            failures.append(
+                "ensemble probe: k=4 deep dispatch diverged from 8 "
+                "solo steps (k-step bit-identity anchor broken)"
+            )
+        rep = obs.metrics.report()
+        mism = sum(rep["counters"].get("ensemble.verify_mismatches", {})
+                   .values())
+        if mism:
+            failures.append(
+                f"ensemble probe: {mism} cohort/solo mismatches after "
+                "the deep-dispatch round — the fori_loop cohort body "
+                "is not bit-identical to the member program"
+            )
+        kgauge = rep["gauges"].get("ensemble.steps_per_dispatch", {})
+        if not any(v > 0 for v in kgauge.values()):
+            failures.append(
+                "ensemble probe: ensemble.steps_per_dispatch gauge "
+                f"missing or zero after a k=4 round: {kgauge}"
+            )
+        hbm_g = rep["gauges"].get("ensemble.hbm_bytes_per_member", {})
+        if not any(v > 0 for v in hbm_g.values()):
+            failures.append(
+                "ensemble probe: ensemble.hbm_bytes_per_member gauge "
+                f"missing or zero after the serving rounds: {hbm_g}"
+            )
     except Exception as e:  # noqa: BLE001 — probe reports, not dies
         failures.append(f"ensemble probe failed: {e!r}")
     return failures

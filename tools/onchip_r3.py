@@ -216,6 +216,15 @@ import bench
 out = bench.measure_split_fused()
 print(json.dumps(out))
 """, 1500),
+    # ISSUE 11: the deep-dispatch ensemble sweep on a real accelerator —
+    # k steps per host dispatch amortizes a round-trip that is far more
+    # expensive against a chip than against the virtual CPU mesh, and the
+    # per-member HBM figures become real allocator headroom there
+    "deep_dispatch": ("""
+import bench
+out = bench.measure_deep_dispatch()
+print(json.dumps(out))
+""", 1500),
     "large": ("import bench\nprint(json.dumps(bench.measure_large()))", 1500),
     "flat_kernel_sweep_Bvox_per_s": ("""
 import tools.flat_kernel_bench as fkb

@@ -206,6 +206,18 @@ GATED_GAUGES_MIN = (
     "ensemble.cohort_peak_occupancy",
 )
 
+#: gauges gated round-over-round where a RISE is the regression
+#: (ISSUE 11): per-member cohort memory (unique table buffers + the
+#: in-flight state cost, per ``obs/hbm.py``) is exactly what buffer
+#: donation and broadcast-shared tables bought down — a round where it
+#: climbs back past the ceiling means stacked table copies or the
+#: dispatch-time state double-buffer crept back in, the scenarios-per-
+#: chip regression this gate exists to catch.  Engages only when both
+#: rounds carry the gauge; per labeled series (one per model kind).
+GATED_GAUGES_MAX = (
+    "ensemble.hbm_bytes_per_member",
+)
+
 
 #: request-latency histograms whose upper quantile is CEILING-gated
 #: round-over-round (ISSUE 10): per labeled series, the current round's
@@ -360,14 +372,20 @@ def load_gauges(path: str) -> dict | None:
 
 def compare_gauges(current: dict | None, baseline: dict | None,
                    threshold: float = 0.35,
-                   gauges=GATED_GAUGES_MIN) -> dict:
-    """Floor gate on per-label gauge values: fails when a gated gauge
-    DROPS below ``baseline * (1 - threshold)`` (regression direction is
-    down — these are goodness fractions).  A labeled series present in
-    the baseline but missing from the current round is a coverage loss
-    and fails; either side lacking the whole table passes vacuously."""
+                   gauges=GATED_GAUGES_MIN, mode: str = "min") -> dict:
+    """Directional gate on per-label gauge values.  ``mode="min"``
+    (floor): fails when a gated gauge DROPS below ``baseline * (1 -
+    threshold)`` — regression direction is down, these are goodness
+    fractions.  ``mode="max"`` (ceiling, ISSUE 11): fails when it
+    RISES above ``baseline * (1 + threshold)`` — regression direction
+    is up, these are costs (per-member HBM).  A labeled series present
+    in the baseline but missing from the current round is a coverage
+    loss and fails; either side lacking the whole table passes
+    vacuously."""
     rows = []
     failures = []
+    if mode not in ("min", "max"):
+        raise ValueError(f"unknown gauge-gate mode {mode!r}")
     if current is None or baseline is None:
         return {"verdict": "PASS", "rows": rows, "failures": failures}
     for name in gauges:
@@ -386,11 +404,17 @@ def compare_gauges(current: dict | None, baseline: dict | None,
                 )
             elif not isinstance(b, (int, float)) or b <= 0:
                 row["status"] = "ok"  # nothing to regress from
-            elif c < b * (1.0 - threshold):
+            elif mode == "min" and c < b * (1.0 - threshold):
                 row["status"] = "REGRESSED"
                 failures.append(
                     f"{name}{{{label}}}: {b} -> {c} "
                     f"(below {1 - threshold:.2f}x floor)"
+                )
+            elif mode == "max" and c > b * (1.0 + threshold):
+                row["status"] = "REGRESSED"
+                failures.append(
+                    f"{name}{{{label}}}: {b} -> {c} "
+                    f"(above {1 + threshold:.2f}x ceiling)"
                 )
             else:
                 row["status"] = "ok"
@@ -655,14 +679,26 @@ def main(argv=None) -> int:
 
     # gauge floor gate (overlap.fraction): engages when both rounds
     # carry the gauge — a drop means compute stopped hiding the halo
-    ggate = compare_gauges(
-        load_gauges(args.current), load_gauges(baseline_path),
-        threshold=args.threshold,
-    )
+    cur_gauges = load_gauges(args.current)
+    base_gauges = load_gauges(baseline_path)
+    ggate = compare_gauges(cur_gauges, base_gauges,
+                           threshold=args.threshold)
     verdict["gauge_gate"] = ggate
     if ggate["verdict"] == "FAIL":
         verdict["verdict"] = "FAIL"
         verdict["failures"] = list(verdict["failures"]) + ggate["failures"]
+
+    # gauge ceiling gate (ISSUE 11): per-member cohort HBM may not rise
+    # past the baseline — the donation + shared-table wins are regress-
+    # able costs, not one-time events
+    cgate_max = compare_gauges(cur_gauges, base_gauges,
+                               threshold=args.threshold,
+                               gauges=GATED_GAUGES_MAX, mode="max")
+    verdict["gauge_ceiling_gate"] = cgate_max
+    if cgate_max["verdict"] == "FAIL":
+        verdict["verdict"] = "FAIL"
+        verdict["failures"] = (list(verdict["failures"])
+                               + cgate_max["failures"])
 
     # quantile ceiling gate (ISSUE 10): the request-latency p99s may
     # not blow past the baseline's — a serving round whose tail latency
