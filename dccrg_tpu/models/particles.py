@@ -114,7 +114,7 @@ class Particles:
         def build():
             def push(local, state, velocity, dt):
                 P = state["particles"].shape[2]
-                slot = jnp.arange(P)[None, None, :]
+                slot = jnp.arange(P, dtype=jnp.int32)[None, None, :]
                 valid = slot < state["number_of_particles"][..., None]
                 v = jnp.asarray(velocity)
                 if v.ndim == 3:          # per-cell field [D, R, 3]
@@ -214,7 +214,8 @@ class Particles:
             ids_s, rows_s, local = ids_s[0], rows_s[0], local[0]
             R, P = pos.shape[0], pos.shape[1]
             dt_ = pos.dtype
-            valid = (jnp.arange(P)[None, :] < cnt[:, None]).reshape(-1)
+            valid = (jnp.arange(P, dtype=jnp.int32)[None, :]
+                     < cnt[:, None]).reshape(-1)
             p = pos.reshape(R * P, 3)
             # the domain is CLOSED ([start, end] per axis), exactly like
             # the host path's geometry: a coordinate sitting on the upper
@@ -253,7 +254,8 @@ class Particles:
             order = jnp.argsort(key)
             ks = key[order]
             ws = wp[order]
-            slot = jnp.arange(R * P) - jnp.searchsorted(ks, ks, side="left")
+            slot = (jnp.arange(R * P, dtype=jnp.int32)
+                    - jnp.searchsorted(ks, ks, side="left"))
             counts = jnp.zeros(R + 1, jnp.int32).at[key].add(1)[:R]
             new_pos = (
                 jnp.zeros((R, P, 3), dt_)
@@ -267,10 +269,10 @@ class Particles:
             # out-ran the ghost halo (the device path's reach limit, like
             # the reference's neighbor handoff)
             before = jax.lax.psum(
-                jnp.sum(cnt * local).astype(jnp.int32), SHARD_AXIS
+                jnp.sum(cnt * local, dtype=jnp.int32), SHARD_AXIS
             )
             after = jax.lax.psum(
-                jnp.sum(new_cnt).astype(jnp.int32), SHARD_AXIS
+                jnp.sum(new_cnt, dtype=jnp.int32), SHARD_AXIS
             )
             return new_pos[None], new_cnt[None], before - after
 

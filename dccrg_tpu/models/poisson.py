@@ -359,7 +359,8 @@ class Poisson:
             project = lambda v: v
 
         def dot(a, b):
-            return jnp.sum(jnp.where(dot_mask, a * b, 0.0))
+            w = jnp.where(dot_mask, a * b, 0.0)
+            return jnp.sum(w, dtype=w.dtype)
 
         @jax.jit
         def solve(state, max_iterations, stop_residual, stop_after_increase):
@@ -439,7 +440,8 @@ class Poisson:
                     return scaling * v + ordered_sum(mult * vn, axis=-1)
 
                 def dot(a, b):
-                    return jnp.sum(jnp.where(solve_mask, a * b, 0.0))
+                    w = jnp.where(solve_mask, a * b, 0.0)
+                    return jnp.sum(w, dtype=w.dtype)
 
                 def lift(row_arr):
                     # boundary cells keep their given solution values:

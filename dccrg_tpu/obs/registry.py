@@ -165,7 +165,8 @@ class MetricsRegistry:
         edges, so ``obs.slo`` merge/quantile consume either resolution.
         Register the same resolution in every process whose exports will
         be merged (bucket keys must coincide)."""
-        self._hist_res[str(name)] = max(int(per_octave), 1)
+        with self._lock:
+            self._hist_res[str(name)] = max(int(per_octave), 1)
 
     def observe(self, name: str, value, **labels) -> None:
         """Record a sample into a histogram (count/sum/min/max plus

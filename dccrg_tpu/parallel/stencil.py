@@ -141,7 +141,7 @@ def gather_neighbors(x, nbr_rows):
     [D, R, K, ...].  Inside a per-device block this is a single XLA gather;
     with both operands sharded on D it needs no communication."""
     D = x.shape[0]
-    return x[jnp.arange(D)[:, None, None], nbr_rows]
+    return x[jnp.arange(D, dtype=jnp.int32)[:, None, None], nbr_rows]
 
 
 def ordered_sum(x, axis: int = -1):

@@ -1,0 +1,481 @@
+"""dccrg-lint: per-rule positive/negative fixtures, baseline round-trip,
+the whole-repo CI gate, the registry thread-race stress test (the
+dynamic oracle behind LOCK-DISCIPLINE), the stdlib-only subprocess
+import probe, and the zero-retrace-under-x64 regression for the
+DTYPE-PROMOTE fixes.
+
+The linter is stdlib-only and file-loaded here (not imported through a
+package) — exactly the loading contract it polices.
+"""
+import importlib.util
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LINT_PATH = REPO / "tools" / "dccrg_lint.py"
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location("dccrg_lint", LINT_PATH)
+    m = importlib.util.module_from_spec(spec)
+    sys.modules["dccrg_lint"] = m
+    spec.loader.exec_module(m)
+    return m
+
+
+lint = _load_lint()
+
+
+def run_rules(root, files, rules, baseline=()):
+    """Materialize fixture `files` under `root` and lint them."""
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    active, suppressed, stale, errors = lint.run_lint(
+        root, rules=rules, baseline_entries=list(baseline))
+    return active, suppressed, stale, errors
+
+
+# ------------------------------------------------------- DTYPE-PROMOTE
+
+DTYPE_BAD = """
+    import jax.numpy as jnp
+
+    def reduce(x):
+        return jnp.sum(x) + jnp.arange(4)[0]
+"""
+DTYPE_GOOD = """
+    import jax.numpy as jnp
+
+    def reduce(x):
+        return (jnp.sum(x, dtype=jnp.int32)
+                + jnp.arange(4, dtype=jnp.int32)[0])
+"""
+
+
+def test_dtype_promote_fires_and_clears(tmp_path):
+    active, _, _, errors = run_rules(
+        tmp_path, {"dccrg_tpu/models/fix.py": DTYPE_BAD},
+        [lint.DtypePromote])
+    assert not errors
+    assert sorted(f.site for f in active) == ["reduce:arange#0",
+                                              "reduce:sum#0"]
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/models/fix.py": DTYPE_GOOD},
+        [lint.DtypePromote])
+    assert active == []
+
+
+def test_dtype_promote_ignores_untraced_scope(tmp_path):
+    # same violation outside models/parallel/serve stays silent
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/utils/free.py": DTYPE_BAD},
+        [lint.DtypePromote])
+    assert active == []
+
+
+def test_unpinning_gol_dtype_fails_the_gate(tmp_path):
+    """Acceptance check: stripping the PR 9 dtype pins out of the real
+    game_of_life.py makes the rule fire on the copy."""
+    src = (REPO / "dccrg_tpu/models/game_of_life.py").read_text()
+    assert "dtype=jnp.uint32" in src
+    unpinned = re.sub(r",\s*dtype=jnp\.uint32", "", src)
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/models/game_of_life.py": unpinned},
+        [lint.DtypePromote])
+    assert any(f.site.endswith(":sum#0") for f in active)
+
+
+# --------------------------------------------------- CLOSED-OVER-TABLE
+
+CLOSURE_BAD = """
+    import jax
+
+    def make(tables, mesh, put_table):
+        statics = tuple(put_table(tables[k], mesh) for k in ("a",))
+
+        @jax.jit
+        def run_fn(state):
+            return state + statics[0]
+
+        return run_fn
+"""
+CLOSURE_GOOD = """
+    import jax
+
+    def make(tables, mesh, put_table):
+        statics = tuple(put_table(tables[k], mesh) for k in ("a",))
+
+        @jax.jit
+        def run_fn(statics, state):
+            return state + statics[0]
+
+        return lambda state: run_fn(statics, state)
+"""
+SELF_READ_BAD = """
+    import jax
+
+    class Model:
+        def __init__(self, tables, mesh, put_table):
+            self._rows = put_table(tables["rows"], mesh)
+
+        @jax.jit
+        def step(self, state):
+            return state + self._rows
+"""
+
+
+def test_closed_over_table_fires_and_clears(tmp_path):
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/ops/fx.py": CLOSURE_BAD},
+        [lint.ClosedOverTable])
+    assert [f.site for f in active] == ["make.run_fn:statics"]
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/ops/fx.py": CLOSURE_GOOD},
+        [lint.ClosedOverTable])
+    assert active == []
+
+
+def test_closed_over_table_self_read(tmp_path):
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/ops/fy.py": SELF_READ_BAD},
+        [lint.ClosedOverTable])
+    assert [f.site for f in active] == ["Model.step:self._rows"]
+
+
+def test_traced_jit_callsite_resolves_lexically(tmp_path):
+    # a module-level function sharing the inner function's name must
+    # not be conflated with the jitted one (the gol `step` shape)
+    src = """
+        import jax
+
+        def build(tables, mesh, put_table):
+            tabs = put_table(tables["t"], mesh)
+
+            def step(tabs, state):
+                return state + tabs
+
+            fn = jax.jit(step)
+
+            def outer_step(state):
+                return fn(tabs, state)   # un-jitted wrapper: fine
+
+            return outer_step
+
+        def step(state):
+            return state
+    """
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/ops/fz.py": src}, [lint.ClosedOverTable])
+    assert active == []
+
+
+# ------------------------------------------------------------ HOST-SYNC
+
+HOT_ENSEMBLE_BAD = """
+    import numpy as np
+
+    class Cohort:
+        def step(self):
+            return np.asarray(self._state)
+
+    class Scheduler:
+        def step_once(self):
+            pass
+
+        def run(self):
+            pass
+"""
+HOT_HALO_OK = """
+    class HaloExchange:
+        def __call__(self, state):
+            return self._dispatch(state)
+
+        def _dispatch(self, state):
+            return state
+
+        def start(self, state):
+            return self._start_dispatch(state)
+
+        def _start_dispatch(self, state):
+            return state
+
+        def finish(self, state, handle):
+            return self._finish_dispatch(state, handle)
+
+        def _finish_dispatch(self, state, handle):
+            return state
+"""
+
+
+def test_host_sync_fires_and_clears(tmp_path):
+    files = {"dccrg_tpu/serve/ensemble.py": HOT_ENSEMBLE_BAD,
+             "dccrg_tpu/parallel/halo.py": HOT_HALO_OK}
+    active, _, _, errors = run_rules(tmp_path, files, [lint.HostSync])
+    assert not errors
+    assert [f.site for f in active] == ["Cohort.step:np.asarray"]
+    files["dccrg_tpu/serve/ensemble.py"] = HOT_ENSEMBLE_BAD.replace(
+        "np.asarray(self._state)", "self._state")
+    active, _, _, _ = run_rules(tmp_path, files, [lint.HostSync])
+    assert active == []
+
+
+# ---------------------------------------------------------- STDLIB-ONLY
+
+def test_stdlib_only_fires_and_clears(tmp_path):
+    active, _, _, _ = run_rules(
+        tmp_path, {"tools/myreport.py": "import jax\n"},
+        [lint.StdlibOnly])
+    assert [f.site for f in active] == ["import:jax"]
+    # lazy (function-level) import is the sanctioned escape hatch
+    active, _, _, _ = run_rules(
+        tmp_path,
+        {"tools/myreport.py": "import json\n\ndef f():\n    import jax\n"},
+        [lint.StdlibOnly])
+    assert active == []
+
+
+def test_stdlib_only_probe_slo_and_report():
+    for rel in ("dccrg_tpu/obs/slo.py", "tools/slo_report.py"):
+        err = lint.StdlibOnly.probe(REPO, rel)
+        assert err is None, f"{rel}: {err}"
+
+
+# ------------------------------------------------------ TELEMETRY-DRIFT
+
+GATE_STUBS = {
+    "tools/check_telemetry.py": """
+        REQUIRED_PHASES = ("epoch.build",)
+        REQUIRED_NONZERO_COUNTERS = ("halo.bytes_moved",)
+        REQUIRED_HISTOGRAMS = ()
+    """,
+    "tools/telemetry_diff.py": """
+        DEFAULT_PHASES = ("epoch.build",)
+        GATED_COUNTERS = ()
+        DEFAULT_ALLOW = ()
+        GATED_GAUGES_MIN = ()
+        GATED_GAUGES_MAX = ()
+        GATED_QUANTILES = ()
+    """,
+}
+# flush-left: fixture variants append unindented lines, and dedent on
+# the concatenation must stay a no-op
+RECORDER_OK = """\
+from .registry import metrics
+
+def work():
+    with metrics.phase("epoch.build"):
+        metrics.inc("halo.bytes_moved", 8)
+"""
+
+
+def test_telemetry_drift_aligned_sets_pass(tmp_path):
+    files = dict(GATE_STUBS)
+    files["dccrg_tpu/obs/code.py"] = RECORDER_OK
+    active, _, _, errors = run_rules(tmp_path, files,
+                                     [lint.TelemetryDrift])
+    assert not errors and active == []
+
+
+def test_telemetry_drift_recorded_but_never_gated(tmp_path):
+    files = dict(GATE_STUBS)
+    files["dccrg_tpu/obs/code.py"] = RECORDER_OK + (
+        "\n\ndef rogue():\n"
+        "    metrics.phase_add(\"rogue.phase\", 0.1)\n")
+    active, _, _, _ = run_rules(tmp_path, files, [lint.TelemetryDrift])
+    assert [f.site for f in active] == ["recorded:phase:rogue.phase"]
+
+
+def test_telemetry_drift_gated_but_never_recorded(tmp_path):
+    files = dict(GATE_STUBS)
+    files["tools/check_telemetry.py"] = GATE_STUBS[
+        "tools/check_telemetry.py"].replace(
+        '("halo.bytes_moved",)', '("halo.bytes_moved", "ghost.series")')
+    files["dccrg_tpu/obs/code.py"] = RECORDER_OK
+    active, _, _, _ = run_rules(tmp_path, files, [lint.TelemetryDrift])
+    assert [f.site for f in active] == ["gate:counter:ghost.series"]
+
+
+# ------------------------------------------------------ LOCK-DISCIPLINE
+
+LOCK_BAD = """
+    import threading
+
+    class Reg:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counters: dict = {}
+
+        def inc(self, key):
+            self._counters[key] = self._counters.get(key, 0) + 1
+"""
+LOCK_GOOD = """
+    import threading
+
+    class Reg:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counters: dict = {}
+
+        def inc(self, key):
+            with self._lock:
+                self._counters[key] = self._counters.get(key, 0) + 1
+"""
+
+
+def test_lock_discipline_fires_and_clears(tmp_path):
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/obs/reg.py": LOCK_BAD},
+        [lint.LockDiscipline])
+    assert [f.site for f in active] == ["Reg.inc:_counters"]
+    active, _, _, _ = run_rules(
+        tmp_path, {"dccrg_tpu/obs/reg.py": LOCK_GOOD},
+        [lint.LockDiscipline])
+    assert active == []
+
+
+def test_registry_thread_race_exact_totals():
+    """Dynamic oracle for LOCK-DISCIPLINE: N threads hammer one
+    registry; every recorded series must land exactly (a lost update
+    anywhere under-counts)."""
+    from dccrg_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    n_threads, n_iter = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        barrier.wait()
+        for i in range(n_iter):
+            reg.inc("race.counter")
+            reg.inc("race.labeled", 2, worker=str(tid % 2))
+            reg.observe("race.hist", 1.5)
+            reg.phase_add("race.phase", 0.001)
+            reg.gauge("race.gauge", i)
+            if i % 64 == 0:
+                # resolution rewrites race against recorders
+                reg.set_histogram_resolution("race.other", 2 + (i % 3))
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    rep = reg.report()
+    total = n_threads * n_iter
+    assert rep["counters"]["race.counter"][""] == total
+    assert sum(rep["counters"]["race.labeled"].values()) == 2 * total
+    h = rep["histograms"]["race.hist"][""]
+    assert h["count"] == total
+    assert h["sum"] == pytest.approx(1.5 * total)
+    assert sum(h["buckets"].values()) == total
+    p = rep["phases"]["race.phase"]
+    assert p["count"] == total
+    # lost updates would under-count; the float total is rounded by
+    # report(), so exactness is asserted on counts and approx on time
+    assert p["total_s"] == pytest.approx(total * 0.001, rel=1e-3)
+
+
+# ------------------------------------------------------------ ENV-DRIFT
+
+def test_env_drift_fires_and_clears(tmp_path):
+    files = {
+        "dccrg_tpu/knob.py":
+            "import os\nV = os.environ.get(\"DCCRG_NEW_KNOB\", \"1\")\n",
+        "README.md": "| `DCCRG_GONE` | `0` | stale row |\n",
+    }
+    active, _, _, _ = run_rules(tmp_path, files, [lint.EnvDrift])
+    assert sorted(f.site for f in active) == [
+        "dead:DCCRG_GONE", "undocumented:DCCRG_NEW_KNOB"]
+    files["README.md"] = "| `DCCRG_NEW_KNOB` | `1` | documented |\n"
+    active, _, _, _ = run_rules(tmp_path, files, [lint.EnvDrift])
+    assert active == []
+
+
+# ------------------------------------------------------------- baseline
+
+def test_baseline_suppress_and_expire(tmp_path):
+    files = {"dccrg_tpu/obs/reg.py": LOCK_BAD}
+    active, _, _, _ = run_rules(tmp_path, files, [lint.LockDiscipline])
+    assert len(active) == 1
+    entries = [{"rule": f.rule, "path": f.path, "site": f.site,
+                "reason": "test"} for f in active]
+    # suppressed: the same finding no longer surfaces
+    active, suppressed, stale, _ = run_rules(
+        tmp_path, files, [lint.LockDiscipline], baseline=entries)
+    assert active == [] and len(suppressed) == 1 and stale == []
+    # fixed source: the entry goes stale (baselines may only shrink)
+    files["dccrg_tpu/obs/reg.py"] = LOCK_GOOD
+    active, suppressed, stale, _ = run_rules(
+        tmp_path, files, [lint.LockDiscipline], baseline=entries)
+    assert active == [] and suppressed == [] and stale == entries
+
+
+# ----------------------------------------------------------- CI gate
+
+def test_repo_is_lint_clean():
+    """The tier-1-visible gate: `dccrg_lint --json` must exit 0 on the
+    repo, with a baseline holding only the documented ROADMAP item-4
+    closed-over-table entries."""
+    r = subprocess.run(
+        [sys.executable, str(LINT_PATH), "--json"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=300)
+    report = json.loads(r.stdout)
+    assert r.returncode == 0, json.dumps(report, indent=2)
+    assert report["findings"] == []
+    assert report["stale_baseline"] == []
+    assert report["errors"] == []
+    baseline = json.loads((REPO / "tools/lint_baseline.json").read_text())
+    rules = {e["rule"] for e in baseline["entries"]}
+    assert rules == {"closed-over-table"}
+    assert all("ROADMAP item 4" in e["reason"]
+               for e in baseline["entries"])
+
+
+# ------------------------------------- dtype regression (zero retrace)
+
+def test_particles_zero_retrace_after_dtype_pins():
+    """The pinned arange/sum sites must not re-key the particle kernels
+    under x64 (conftest enables x64 globally): after the first step's
+    traces, further dispatches at a held signature retrace nothing."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models.particles import Particles
+    from dccrg_tpu.parallel.exec_cache import trace_counts
+
+    n = np.asarray((8, 8, 1))
+    g = (
+        Grid()
+        .set_initial_length((8, 8, 1))
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .set_periodic(True, True, False)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=tuple(1.0 / n))
+        .initialize(mesh=make_mesh(n_devices=None))
+    )
+    p = Particles(g)
+    state = p.new_state(np.array([[0.05, 0.5, 0.5], [0.55, 0.25, 0.5]]))
+    # two warmup steps: the first dispatch re-buckets the fresh state,
+    # which re-keys once (pre-existing, signature-driven — verified
+    # identical before the dtype pins)
+    for _ in range(2):
+        state = p.step(state, velocity=(0.1, 0.0, 0.0), dt=1.0)
+    base = trace_counts()
+    for _ in range(3):
+        state = p.step(state, velocity=(0.1, 0.0, 0.0), dt=1.0)
+    fresh = {k: v - base.get(k, 0) for k, v in trace_counts().items()
+             if v != base.get(k, 0)}
+    assert not fresh, f"unexpected retrace at held signature: {fresh}"
+    assert p.count(state) == 2

@@ -159,6 +159,7 @@ REQUIRED_NONZERO_COUNTERS = (
 #: per-request latency distributions the SLO report quantiles, and the
 #: phase-duration series the registry's observe_duration hook feeds
 REQUIRED_HISTOGRAMS = (
+    "ensemble.queue_latency",
     "ensemble.queue_wait_s",
     "ensemble.service_s",
     "ensemble.e2e_s",
