@@ -758,6 +758,24 @@ def measure_deep_dispatch() -> dict:
     return out
 
 
+def measure_wide_halo() -> dict:
+    """ISSUE 14 on-chip target: exchange-amortized deep dispatch — the
+    g×k sweep comparing wide-halo cohort bodies (one depth-g exchange
+    per g interior steps) against exchange-every-step bodies on the same
+    grid, with the per-g oracle round and the halo.exchanges_per_step
+    gauge readings.  On a real accelerator the exchange this elides is
+    an ICI collective, not a host memcpy, so the amortization margin
+    grows with the fabric cost."""
+    import jax
+
+    from benchmarks.microbench import wide_halo_summary
+
+    out = wide_halo_summary()
+    out["device_kind"] = jax.devices()[0].device_kind
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def measure_multidev_cpu() -> dict | None:
     """8-device virtual CPU mesh (subprocess): plumbing/correctness
     evidence (device-count-invariant checksum) plus the split-phase

@@ -732,6 +732,155 @@ def ensemble_summary(length: int = 4, steps: int = 16,
     return out
 
 
+def wide_halo_summary(length: int = 6, steps: int = 16, B: int = 16,
+                      gs=(2, 4), ks=(4, 16), seed: int = 0) -> dict:
+    """Exchange amortization sweep (ISSUE 14): scenarios·steps/sec per
+    chip for wide-halo cohort bodies (ONE depth-g exchange per g
+    interior steps) vs the legacy per-step-exchange bodies, over ghost
+    depths ``gs`` × dispatch depths ``ks``, importable so ``bench.py``
+    folds it into the on-chip battery.
+
+    Each g gets its own grid (``set_neighborhood_length(g)`` fixes the
+    ghost-zone depth) with GoL on a radius-1 Moore sub-hood, so the
+    wide budget is exactly g; dispatches run ``cohort.step(k)``
+    directly so k past the budget exercises the multi-block form
+    (``ceil(k/g)`` exchanges).  The legacy variant is the SAME grid
+    and cohort shape with ``DCCRG_ENSEMBLE_WIDE=0`` — the measured
+    difference is purely exchange amortization.  Each cell reports the
+    cumulative ``halo.exchanges_per_step`` ratio beside the rates; a
+    tiny oracle-armed round per g keeps the sweep honest."""
+    import os
+
+    import jax
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.parallel import halo
+    from dccrg_tpu.serve import Scenario, Scheduler
+
+    moore = [(i, j, k) for i in (-1, 0, 1) for j in (-1, 0, 1)
+             for k in (-1, 0, 1) if (i, j, k) != (0, 0, 0)]
+    rng = np.random.default_rng(seed)
+    out: dict = {"model": "gol", "B": int(B), "steps": int(steps),
+                 "gs": [int(g) for g in gs], "ks": [int(k) for k in ks],
+                 "g": {}, "verify": {}}
+
+    def run_cells(gol, wide: bool) -> dict:
+        cells = gol.grid.get_cells()
+        res: dict = {}
+        for k in ks:
+            sched = Scheduler()
+            iters = max(1, steps // k)
+            for i in range(B):
+                sched.submit(Scenario(
+                    gol,
+                    gol.new_state(alive_cells=cells[
+                        rng.random(len(cells)) < 0.3]),
+                    k * (iters + 1), tenant=f"t{i}"))
+            sched.admit()
+            cohort = next(iter(sched.cohorts.values()))
+            cohort.step(k)                 # warm the (k, g) body
+            jax.block_until_ready(cohort._state)
+            halo._amortization.clear()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                cohort.step(k)
+            jax.block_until_ready(cohort._state)
+            elapsed = time.perf_counter() - t0
+            chips = max(gol.grid.n_devices, 1)
+            rep = _registry_report()
+            res[str(k)] = {
+                "dispatch_s": round(elapsed / iters, 6),
+                "scenarios_steps_per_s_per_chip": round(
+                    B * k * iters / max(elapsed, 1e-12) / chips, 1),
+                "exchanges_per_step": rep["gauges"].get(
+                    "halo.exchanges_per_step", {}).get("model=gol"),
+                "wide": bool(cohort._wide is not None) if wide
+                else False,
+            }
+        return res
+
+    prev = os.environ.get("DCCRG_ENSEMBLE_WIDE")
+    for gdepth in gs:
+        grid = (
+            Grid()
+            .set_initial_length((length, length, length))
+            .set_neighborhood_length(int(gdepth))
+            .set_periodic(True, True, True)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / length,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        grid.stop_refining()
+        grid.add_neighborhood(7, moore)
+        try:
+            os.environ.pop("DCCRG_ENSEMBLE_WIDE", None)
+            wide_gol = GameOfLife(grid, hood_id=7, allow_dense=False)
+            wide_cells = run_cells(wide_gol, wide=True)
+            os.environ["DCCRG_ENSEMBLE_WIDE"] = "0"
+            legacy_gol = GameOfLife(grid, hood_id=7, allow_dense=False)
+            legacy_cells = run_cells(legacy_gol, wide=False)
+        finally:
+            if prev is None:
+                os.environ.pop("DCCRG_ENSEMBLE_WIDE", None)
+            else:
+                os.environ["DCCRG_ENSEMBLE_WIDE"] = prev
+        ent: dict = {"k": {}}
+        for k in ks:
+            w, l = wide_cells[str(k)], legacy_cells[str(k)]
+            ent["k"][str(k)] = {
+                "wide": w, "legacy": l,
+                "speedup": round(
+                    w["scenarios_steps_per_s_per_chip"]
+                    / max(l["scenarios_steps_per_s_per_chip"], 1e-12),
+                    3),
+            }
+        out["g"][str(gdepth)] = ent
+        # oracle-armed round at this depth: the sweep's numbers must
+        # never outrun the owned-row bit-identity anchor
+        c0 = _counter_total("ensemble.verify_checks")
+        m0 = _counter_total("ensemble.verify_mismatches")
+        vs = Scheduler(steps_per_dispatch=min(int(gdepth), 4),
+                       verify=True)
+        cells = wide_gol.grid.get_cells()
+        for i in range(2):
+            vs.submit(Scenario(
+                wide_gol,
+                wide_gol.new_state(alive_cells=cells[
+                    rng.random(len(cells)) < 0.3]),
+                2 * int(gdepth), tenant=f"v{i}"))
+        vs.run()
+        out["verify"][str(gdepth)] = {
+            "checks": _counter_total("ensemble.verify_checks") - c0,
+            "mismatches":
+                _counter_total("ensemble.verify_mismatches") - m0,
+        }
+    return out
+
+
+def bench_wide_halo(length: int = 6, steps: int = 16):
+    """Print the :func:`wide_halo_summary` sweep as a bench metric: the
+    deepest (g, k) cell's wide-over-legacy throughput ratio."""
+    s = wide_halo_summary(length=length, steps=steps)
+    gmax, kmax = str(max(int(g) for g in s["gs"])), \
+        str(max(int(k) for k in s["ks"]))
+    cell = s["g"][gmax]["k"][kmax]
+    print(json.dumps({
+        "bench": "wide_halo",
+        "metric": "wide_over_legacy_speedup",
+        "value": cell["speedup"],
+        "detail": s,
+    }))
+
+
+def _counter_total(name: str) -> int:
+    rep = _registry_report()
+    return int(sum(rep["counters"].get(name, {}).values()))
+
+
 def _registry_report() -> dict:
     from dccrg_tpu import obs
 
@@ -1027,6 +1176,7 @@ def main():
     bench_churn_compile()
     bench_halo_overlap()
     bench_ensemble()
+    bench_wide_halo()
     bench_particles(args.particles)
 
 

@@ -61,7 +61,7 @@ def _flat_boxed_edge() -> float:
     return _calibrated_edge("flat_boxed_edge", 2.0)
 
 
-def build_face_tables(grid, hood_id, tables, dtype):
+def build_face_tables(grid, hood_id, tables, dtype, hood_arrays=None):
     """Classify each neighbor entry as a face neighbor with a signed
     direction, reproducing the offset logic of ``solve.hpp:71-123``
     (overlap in exactly 2 dims + contact in 1), plus the physical
@@ -69,15 +69,27 @@ def build_face_tables(grid, hood_id, tables, dtype):
     Advection and the AMR Vlasov path.  Returns ``(host, dev)``: numpy
     tables {face_dir, min_area, cell_axis_len, nbr_axis_len,
     inv_volume} and the device dict (axis_idx included) for jitted
-    steps."""
+    steps.
+
+    ``hood_arrays`` overrides the neighbor tables the classification
+    reads: an ``(nbr_offset, nbr_len, nbr_rows, nbr_valid)`` tuple, e.g.
+    a wide-halo plan's device-extended tables (ISSUE 14) whose ghost
+    rows also carry gather entries.  The geometry side
+    (``tables.length``, ``epoch.cell_len``) already covers ghost rows,
+    so the same pricing applies; owner-local rows stay bitwise equal to
+    the default-hood result."""
     from ..core.neighbors import face_directions
 
     epoch = grid.epoch
-    hood = epoch.hoods[hood_id]
-    off = hood.nbr_offset.astype(np.int64)          # [D, R, K, 3]
-    nlen = hood.nbr_len.astype(np.int64)            # [D, R, K]
+    if hood_arrays is None:
+        hood = epoch.hoods[hood_id]
+        hood_arrays = (hood.nbr_offset, hood.nbr_len, hood.nbr_rows,
+                       hood.nbr_valid)
+    h_off, h_nlen, nb, valid = hood_arrays
+    off = np.asarray(h_off).astype(np.int64)        # [D, R, K, 3]
+    nlen = np.asarray(h_nlen).astype(np.int64)      # [D, R, K]
     clen = epoch.cell_len.astype(np.int64)[..., None]  # [D, R, 1]
-    valid = hood.nbr_valid
+    valid = np.asarray(valid)
 
     direction = np.where(
         valid, face_directions(off, clen, nlen), 0
@@ -87,8 +99,7 @@ def build_face_tables(grid, hood_id, tables, dtype):
     length = np.asarray(tables.length)               # [D, R, 3]
     vol = length.prod(axis=-1)                       # [D, R]
     # gather neighbor physical lengths host-side
-    D, R, K = hood.nbr_rows.shape
-    nb = hood.nbr_rows
+    D, R, K = np.asarray(nb).shape
     nlen_phys = length[np.arange(D)[:, None, None], nb]  # [D, R, K, 3]
 
     axis_idx = np.abs(direction).astype(np.int64) - 1    # [D, R, K]
@@ -633,17 +644,25 @@ class Advection:
         self._flat_nx_pad = nxp if nxp != nx1 else None
         kernel = make_flat_amr_run(nz1, ny1, nx1, nx_pad=self._flat_nx_pad,
                                    interpret=interpret)
-        rows = jnp.asarray(t["rows"])
         leaf = t["leaf_fine"]
-        updf = jnp.asarray(leaf.astype(np.float64) / t["vol_f"], jnp.float32)
-        updc = jnp.asarray((~leaf).astype(np.float64) / t["vol_c"], jnp.float32)
-        wb_rows = jnp.asarray(t["wb_rows"])
-        wb_valid = jnp.asarray(t["wb_valid"])
+        # runtime-argument tables (not closed over): the jitted body is
+        # content-independent, so regridding rebuilds only this pytree
+        tabs = {
+            "rows": jnp.asarray(t["rows"]),
+            "updf": jnp.asarray(
+                leaf.astype(np.float64) / t["vol_f"], jnp.float32
+            ),
+            "updc": jnp.asarray(
+                (~leaf).astype(np.float64) / t["vol_c"], jnp.float32
+            ),
+            "wb_rows": jnp.asarray(t["wb_rows"]),
+            "wb_valid": jnp.asarray(t["wb_valid"]),
+        }
 
         @jax.jit
-        def run_fn(state, steps, dt):
+        def run_fn(tabs, state, steps, dt):
             def field(name):
-                return state[name][0][rows].reshape(nz1, ny1, nx1)
+                return state[name][0][tabs["rows"]].reshape(nz1, ny1, nx1)
 
             V = field("density")
             w = compute_flat_weights(
@@ -651,11 +670,13 @@ class Advection:
             )
             (wpx, wnx), (wpy, wny), (wpz, wnz) = w
             out = kernel(
-                V, wpx, wnx, wpy, wny, wpz, wnz, updf, updc,
+                V, wpx, wnx, wpy, wny, wpz, wnz,
+                tabs["updf"], tabs["updc"],
                 jnp.asarray(dt, jnp.float32), steps,
             )
             rho = jnp.where(
-                wb_valid, out.reshape(-1)[wb_rows], state["density"][0]
+                tabs["wb_valid"], out.reshape(-1)[tabs["wb_rows"]],
+                state["density"][0],
             )
             return {
                 **state,
@@ -663,7 +684,7 @@ class Advection:
                 "flux": jnp.zeros_like(state["flux"]),
             }
 
-        return run_fn
+        return lambda state, steps, dt: run_fn(tabs, state, steps, dt)
 
     def _build_ml_pallas_run(self, t, interpret):
         """VMEM-resident whole-run for a 3+-level grid on one device:
@@ -1140,6 +1161,106 @@ class Advection:
     def step(self, state, dt):
         return self._step(state, dt)
 
+    def _wide_spec(self):
+        """Exchange-amortized step split (ISSUE 14): one full-depth
+        default-hood density exchange funds ``budget`` interior steps.
+        Stencil relevance is ``"face"`` — the flux kernel masks every
+        non-face entry to an exact 0.0 via ``face_dir``, so a depth-g
+        default hood funds g face-stencil steps even though corner
+        neighbors of deep ghost rows are absent on the replica.  Ghost
+        velocities are valid forever (``initialize_state`` ends with a
+        full-state exchange and the fields are static), so only density
+        staleness meters the budget."""
+        from ..parallel.exec_cache import WideStepSpec, traced_jit
+        from ..parallel.mesh import put_table
+        from ..parallel.wide_halo import get_wide_plan, wide_enabled
+
+        if not wide_enabled() or self.tables is None:
+            return None
+        cached = getattr(self, "_wide_cached", None)
+        if cached is not None and cached[0] is self.grid.epoch:
+            return cached[1]
+        plan = get_wide_plan(self.grid, self.hood_id, relevance="face")
+        spec = None
+        if plan.budget >= 2:
+            wex = self.grid.halo(None)
+            wex_body = wex.raw_body
+            wrings = tuple(wex.ring_send) + tuple(wex.ring_recv)
+            mesh = self.grid.mesh
+            _, wdev = build_face_tables(
+                self.grid, self.hood_id, self.tables, self.dtype,
+                hood_arrays=(plan.nbr_offset, plan.nbr_len,
+                             plan.nbr_rows, plan.nbr_valid),
+            )
+            wt = dict(wdev)
+            wt["nbr_rows"] = put_table(plan.nbr_rows, mesh)
+            wt["steps_ok"] = put_table(plan.steps_ok, mesh)
+
+            def build():
+                def interior(wt, state, dt, j):
+                    rho = state["density"]
+                    nbr = wt["nbr_rows"]
+                    rho_n = gather_neighbors(rho, nbr)
+                    vx_n = gather_neighbors(state["vx"], nbr)
+                    vy_n = gather_neighbors(state["vy"], nbr)
+                    vz_n = gather_neighbors(state["vz"], nbr)
+
+                    sgn = jnp.sign(wt["face_dir"]).astype(rho.dtype)
+                    ai = wt["axis_idx"]
+                    v_cell = jnp.where(
+                        ai == 0, state["vx"][..., None],
+                        jnp.where(ai == 1, state["vy"][..., None],
+                                  state["vz"][..., None]),
+                    )
+                    v_nbr = jnp.where(
+                        ai == 0, vx_n, jnp.where(ai == 1, vy_n, vz_n)
+                    )
+                    cl, nl = wt["cell_axis_len"], wt["nbr_axis_len"]
+                    v_face = (cl * v_nbr + nl * v_cell) / (cl + nl)
+
+                    upwind_pos = jnp.where(
+                        v_face >= 0, rho[..., None], rho_n
+                    )
+                    upwind_neg = jnp.where(
+                        v_face >= 0, rho_n, rho[..., None]
+                    )
+                    upwind = jnp.where(sgn > 0, upwind_pos, upwind_neg)
+                    face_flux = upwind * dt * v_face * wt["min_area"]
+                    contrib = jnp.where(
+                        wt["face_dir"] != 0, -sgn * face_flux, 0.0
+                    )
+                    flux = ordered_sum(contrib, axis=-1) * wt["inv_volume"]
+
+                    # live = rows whose stencil inputs are still exact at
+                    # interior step j; identical flux math as the fused
+                    # step over bitwise-equal table rows, so live local
+                    # rows match the exchange-every-step path exactly
+                    live = wt["steps_ok"] > j
+                    new_rho = jnp.where(live, rho + flux, rho)
+                    return {**state, "density": new_rho,
+                            "flux": jnp.zeros_like(flux)}
+
+                return traced_jit("advection.wide_step", interior)
+
+            fn = self.grid.exec_cache.get(
+                ("advection.wide_step", wex.structure_key,
+                 str(np.dtype(self.dtype))), build
+            )
+            spec = WideStepSpec(
+                exchange=lambda args, wargs, state: {
+                    **state,
+                    **wex_body(*wargs[0], {"density": state["density"]}),
+                },
+                interior=lambda args, wargs, state, dt, j: fn(
+                    wargs[1], state, dt, j
+                ),
+                budget=plan.budget,
+                args=(wrings, wt),
+                local_mask=plan.local_mask,
+            )
+        self._wide_cached = (self.grid.epoch, spec)
+        return spec
+
     def batch_step_spec(self):
         """This model's step entry point in cohort-batchable form
         (ISSUE 9): the compiled member program plus its runtime-argument
@@ -1168,6 +1289,7 @@ class Advection:
                 call=lambda args, state, dt: step(state, dt),
                 args=(), dt_dtype=dtype, steps_per_dispatch=k,
             )
+        wide = self._wide_spec()
         if self.overlap:
             fn = self._split_fn
             return BatchStepSpec(
@@ -1175,7 +1297,7 @@ class Advection:
                 kernel_key=self._kernel_key("advection.split_step"),
                 call=lambda args, state, dt: fn(*args, state, dt),
                 args=self._split_args, dt_dtype=dtype,
-                steps_per_dispatch=k,
+                steps_per_dispatch=k, wide=wide,
             )
         fn = self._step_fn
         return BatchStepSpec(
@@ -1183,7 +1305,7 @@ class Advection:
             kernel_key=self._kernel_key("advection.step"),
             call=lambda args, state, dt: fn(*args, state, dt),
             args=(self._rings, self.tables.tree(), self._dev),
-            dt_dtype=dtype, steps_per_dispatch=k,
+            dt_dtype=dtype, steps_per_dispatch=k, wide=wide,
         )
 
     def _record_run(self, path: str, steps, state) -> None:
@@ -1256,6 +1378,28 @@ class Advection:
                 state, jnp.asarray(steps, jnp.int32), jnp.asarray(dt, self.dtype)
             )
         if not hasattr(self, "_run"):
+            from ..parallel.exec_cache import (
+                record_run_donation,
+                run_donate_enabled,
+            )
+
+            donate = run_donate_enabled()
+
+            def probe_wrap(dispatch):
+                """Measure donation effectiveness per dispatch via the
+                ``is_deleted`` probe, like the ensemble's stacked-state
+                donation path."""
+                if not donate:
+                    return dispatch
+
+                def wrapped(state, steps, dt):
+                    probe = state["density"]
+                    out = dispatch(state, steps, dt)
+                    record_run_donation("advection", probe)
+                    return out
+
+                return wrapped
+
             if getattr(self, "_split_fn", None) is not None:
                 from ..parallel.exec_cache import traced_jit
 
@@ -1270,15 +1414,21 @@ class Advection:
                             state,
                         )
 
-                    return traced_jit("advection.split_run", run_fn)
+                    # state is positional arg 4; donation joins the
+                    # cache key so flipping DCCRG_RUN_DONATE re-keys
+                    return traced_jit(
+                        "advection.split_run", run_fn,
+                        donate_argnums=(4,) if donate else (),
+                    )
 
                 fn = self.grid.exec_cache.get(
-                    self._kernel_key("advection.split_run"), build
+                    self._kernel_key("advection.split_run") + (donate,),
+                    build,
                 )
                 args = self._split_args
-                self._run = lambda state, steps, dt: fn(
+                self._run = probe_wrap(lambda state, steps, dt: fn(
                     *args, state, steps, dt
-                )
+                ))
             elif hasattr(self, "_step_fn"):
                 from ..parallel.exec_cache import traced_jit
 
@@ -1292,15 +1442,19 @@ class Advection:
                             state,
                         )
 
-                    return traced_jit("advection.run", run_fn)
+                    # state is positional arg 3
+                    return traced_jit(
+                        "advection.run", run_fn,
+                        donate_argnums=(3,) if donate else (),
+                    )
 
                 fn = self.grid.exec_cache.get(
-                    self._kernel_key("advection.run"), build
+                    self._kernel_key("advection.run") + (donate,), build
                 )
                 rings, t, dev = self._rings, self.tables.tree(), self._dev
-                self._run = lambda state, steps, dt: fn(
+                self._run = probe_wrap(lambda state, steps, dt: fn(
                     rings, t, dev, state, steps, dt
-                )
+                ))
             else:
                 # dense XLA-only path: the step came from the cached
                 # dense bundle (plain (state, dt) signature)

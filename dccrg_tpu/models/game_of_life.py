@@ -398,6 +398,82 @@ class GameOfLife:
     def step(self, state):
         return self._step(state)
 
+    def _wide_spec(self):
+        """Exchange-amortized step split (ISSUE 14).  The life rule reads
+        the WHOLE neighborhood, so stencil relevance is ``"all"``: on the
+        default hood the budget collapses to 1 (the rule genuinely has
+        the hood's radius) and wide stepping disengages; amortization
+        engages when this model steps on a radius-1 sub-hood of a deeper
+        default hood — the exchange then refills the full-depth ghost
+        zone while ``steps_ok`` meters its shell-by-shell consumption."""
+        from ..parallel.exec_cache import WideStepSpec, traced_jit
+        from ..parallel.mesh import put_table
+        from ..parallel.wide_halo import get_wide_plan, wide_enabled
+
+        if not wide_enabled():
+            return None
+        cached = getattr(self, "_wide_cached", None)
+        if cached is not None and cached[0] is self.grid.epoch:
+            return cached[1]
+        plan = get_wide_plan(self.grid, self.hood_id, relevance="all")
+        spec = None
+        if plan.budget >= 2:
+            wex = self.grid.halo(None)
+            wex_body = wex.raw_body
+            wrings = tuple(wex.ring_send) + tuple(wex.ring_recv)
+            mesh = self.grid.mesh
+            wtabs = {
+                "nbr_rows": put_table(plan.nbr_rows, mesh),
+                "nbr_valid": put_table(plan.nbr_valid, mesh),
+                "steps_ok": put_table(plan.steps_ok, mesh),
+                "local_mask": put_table(plan.local_mask, mesh),
+            }
+
+            def build():
+                def interior(wtabs, state, j):
+                    alive = state["is_alive"]
+                    nbr_alive = gather_neighbors(
+                        alive, wtabs["nbr_rows"]
+                    )
+                    count = jnp.sum(
+                        jnp.where(wtabs["nbr_valid"],
+                                  (nbr_alive > 0).astype(jnp.uint32), 0),
+                        axis=-1, dtype=jnp.uint32,
+                    )
+                    new_alive = _life_rule(count, alive)
+                    live = wtabs["steps_ok"] > j
+                    # local rows (live through the whole budget) match
+                    # the blocking step bitwise: same gather/count/rule
+                    # over identical table rows; the stale fringe keeps
+                    # its exchanged values
+                    return {
+                        "is_alive": jnp.where(live, new_alive, alive),
+                        "live_neighbor_count": jnp.where(
+                            live & wtabs["local_mask"], count,
+                            jnp.where(live, jnp.uint32(0),
+                                      state["live_neighbor_count"]),
+                        ),
+                    }
+
+                return traced_jit("gol.wide_step", interior)
+
+            fn = self.grid.exec_cache.get(
+                ("gol.wide_step", wex.structure_key), build
+            )
+            spec = WideStepSpec(
+                exchange=lambda args, wargs, state: wex_body(
+                    *wargs[0], state
+                ),
+                interior=lambda args, wargs, state, dt, j: fn(
+                    wargs[1], state, j
+                ),
+                budget=plan.budget,
+                args=(wrings, wtabs),
+                local_mask=plan.local_mask,
+            )
+        self._wide_cached = (self.grid.epoch, spec)
+        return spec
+
     def batch_step_spec(self):
         """Cohort-batchable step entry point (ISSUE 9; see
         ``Advection.batch_step_spec``).  GoL takes no dt — the cohort's
@@ -410,6 +486,7 @@ class GameOfLife:
 
         k = default_steps_per_dispatch()
         ex = self._exchange
+        wide = self._wide_spec()
         if self.tables is None:          # overlap=True split-phase form
             fn = self._overlap_fn
 
@@ -422,13 +499,13 @@ class GameOfLife:
                 kind="gol.overlap",
                 kernel_key=("gol.overlap_step", ex.structure_key),
                 call=call, args=self._overlap_args,
-                steps_per_dispatch=k,
+                steps_per_dispatch=k, wide=wide,
             )
         fn = self._step_fn
         return BatchStepSpec(
             kind="gol", kernel_key=("gol.step", ex.structure_key),
             call=lambda args, state, dt: fn(args[0], args[1], state),
-            args=self._step_args, steps_per_dispatch=k,
+            args=self._step_args, steps_per_dispatch=k, wide=wide,
         )
 
     def run(self, state, turns: int, sync_every: int = 16):

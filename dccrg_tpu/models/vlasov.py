@@ -345,11 +345,22 @@ class Vlasov:
                     state,
                 )
 
-            return step_k, traced_jit("vlasov.run", run)
+            # state is positional arg 6 of run; donation joins the cache
+            # key below so flipping DCCRG_RUN_DONATE re-keys, not re-uses
+            return step_k, traced_jit(
+                "vlasov.run", run,
+                donate_argnums=(6,) if donate else (),
+            )
 
+        from ..parallel.exec_cache import (
+            record_run_donation,
+            run_donate_enabled,
+        )
+
+        donate = run_donate_enabled()
         step_fn, run_fn = self.grid.exec_cache.get(
             ("vlasov.step", ex.structure_key, str(np.dtype(dtype)),
-             has_open), build
+             has_open, donate), build
         )
         vbT = jnp.asarray(self.v_bins.T, dtype)
         args = (rings, t, dev, vbT, bnd_pos_dev, bnd_neg_dev)
@@ -358,9 +369,18 @@ class Vlasov:
         self._step = self._step_xla = (
             lambda state, dt: step_fn(*args, state, dt)
         )
-        self._run = self._run_xla = (
-            lambda state, steps, dt: run_fn(*args, state, steps, dt)
-        )
+        if donate:
+            def run_donated(state, steps, dt):
+                probe = state["f"]
+                out = run_fn(*args, state, steps, dt)
+                record_run_donation("vlasov", probe)
+                return out
+
+            self._run = self._run_xla = run_donated
+        else:
+            self._run = self._run_xla = (
+                lambda state, steps, dt: run_fn(*args, state, steps, dt)
+            )
         if self.overlap:
             # the eager kernels above stay on _step_xla/_run_xla (the
             # in-process oracle); step()/run() take the fused split form
@@ -472,18 +492,37 @@ class Vlasov:
                     state,
                 )
 
-            return step_k, traced_jit("vlasov.split_run", run)
+            # state is positional arg 5 of run (see _build_general_step)
+            return step_k, traced_jit(
+                "vlasov.split_run", run,
+                donate_argnums=(5,) if donate else (),
+            )
 
+        from ..parallel.exec_cache import (
+            record_run_donation,
+            run_donate_enabled,
+        )
+
+        donate = run_donate_enabled()
         step_fn, run_fn = self.grid.exec_cache.get(
             ("vlasov.split_step", ex.structure_key, str(np.dtype(dtype)),
-             has_open), build
+             has_open, donate), build
         )
         vbT = jnp.asarray(self.v_bins.T, dtype)
         args = (rings, inner, outer, local, vbT)
         self._split_fn_k, self._split_args = step_fn, args
         self._step = lambda state, dt: step_fn(*args, state, dt)
-        self._run = lambda state, steps, dt: run_fn(*args, state, steps,
-                                                    dt)
+        if donate:
+            def run_donated(state, steps, dt):
+                probe = state["f"]
+                out = run_fn(*args, state, steps, dt)
+                record_run_donation("vlasov", probe)
+                return out
+
+            self._run = run_donated
+        else:
+            self._run = lambda state, steps, dt: run_fn(*args, state,
+                                                        steps, dt)
 
     # ------------------------------------------------------------ user API
 
@@ -526,6 +565,128 @@ class Vlasov:
             )
         return self._step(state, dt)
 
+    def _wide_spec(self):
+        """Exchange-amortized step split (ISSUE 14; see
+        ``Advection._wide_spec`` — same face-relevance argument, applied
+        per velocity bin).  The open-boundary face areas are scattered to
+        EVERY replica row (``wide_halo.scatter_rows``), since interior
+        steps update live ghost rows too and the owner-rows-only scatter
+        of ``_build_general_step`` would silently zero their outflow."""
+        from ..parallel.exec_cache import WideStepSpec, traced_jit
+        from ..parallel.mesh import put_table
+        from ..parallel.stencil import gather_neighbors, ordered_sum
+        from ..parallel.wide_halo import (
+            get_wide_plan,
+            scatter_rows,
+            wide_enabled,
+        )
+        from .advection import build_face_tables
+
+        if not wide_enabled() or self.info is not None:
+            return None
+        cached = getattr(self, "_wide_cached", None)
+        if cached is not None and cached[0] is self.grid.epoch:
+            return cached[1]
+        grid = self.grid
+        plan = get_wide_plan(grid, None, relevance="face")
+        spec = None
+        if plan.budget >= 2:
+            dtype = self.dtype
+            wex = grid.halo(None)
+            wex_body = wex.raw_body
+            wrings = tuple(wex.ring_send) + tuple(wex.ring_recv)
+            mesh = grid.mesh
+            _, wdev = build_face_tables(
+                grid, None, self.tables, dtype,
+                hood_arrays=(plan.nbr_offset, plan.nbr_len,
+                             plan.nbr_rows, plan.nbr_valid),
+            )
+            wt = dict(wdev)
+            wt["nbr_rows"] = put_table(plan.nbr_rows, mesh)
+            wt["steps_ok"] = put_table(plan.steps_ok, mesh)
+
+            epoch = grid.epoch
+            mapping = epoch.mapping
+            cells = epoch.leaves.cells
+            idxs = mapping.get_indices(cells).astype(np.int64)
+            clen = mapping.get_cell_length_in_indices(cells)
+            clen = clen.astype(np.int64)
+            lengths = np.asarray(
+                grid.geometry.get_length(cells), np.float64
+            )
+            extent = (np.asarray(mapping.length, np.int64)
+                      << mapping.max_refinement_level)
+            has_open = self._has_open
+            for d3 in range(3):
+                pos_leaf = np.zeros(len(cells))
+                neg_leaf = np.zeros(len(cells))
+                if not grid.topology.is_periodic(d3):
+                    area = (lengths[:, (d3 + 1) % 3]
+                            * lengths[:, (d3 + 2) % 3])
+                    hi = (idxs[:, d3] + clen) == extent[d3]
+                    pos_leaf = np.where(hi, area, 0.0)
+                    neg_leaf = np.where(idxs[:, d3] == 0, area, 0.0)
+                wt[f"bnd_pos{d3}"] = put_table(
+                    scatter_rows(epoch, pos_leaf), mesh, dtype
+                )
+                wt[f"bnd_neg{d3}"] = put_table(
+                    scatter_rows(epoch, neg_leaf), mesh, dtype
+                )
+
+            def build():
+                def interior(wt, vbT, state, dt, j):
+                    f = state["f"]                            # [D, R, B]
+                    f_n = gather_neighbors(f, wt["nbr_rows"])
+                    sgn = jnp.sign(wt["face_dir"]).astype(
+                        f.dtype
+                    )[..., None]
+                    ai = wt["axis_idx"].astype(jnp.int32)
+                    v_face = vbT[ai]
+                    f_c = f[:, :, None, :]
+                    up_pos = jnp.where(v_face >= 0, f_c, f_n)
+                    up_neg = jnp.where(v_face >= 0, f_n, f_c)
+                    upwind = jnp.where(sgn > 0, up_pos, up_neg)
+                    face_flux = (upwind * (dt * v_face)
+                                 * wt["min_area"][..., None])
+                    contrib = jnp.where(
+                        (wt["face_dir"] != 0)[..., None],
+                        -sgn * face_flux, 0.0,
+                    )
+                    total = ordered_sum(contrib, axis=-2)
+                    if has_open:
+                        rate = sum(
+                            wt[f"bnd_pos{d3}"][..., None]
+                            * jnp.maximum(vbT[d3], 0)
+                            + wt[f"bnd_neg{d3}"][..., None]
+                            * jnp.maximum(-vbT[d3], 0)
+                            for d3 in range(3)
+                        )
+                        total = total - dt * f * rate
+                    flux = total * wt["inv_volume"][..., None]
+                    live = (wt["steps_ok"] > j)[..., None]
+                    return {**state, "f": jnp.where(live, f + flux, f)}
+
+                return traced_jit("vlasov.wide_step", interior)
+
+            fn = self.grid.exec_cache.get(
+                ("vlasov.wide_step", wex.structure_key,
+                 str(np.dtype(dtype)), has_open, self.nv), build
+            )
+            vbT = jnp.asarray(self.v_bins.T, dtype)
+            spec = WideStepSpec(
+                exchange=lambda args, wargs, state: {
+                    **state, **wex_body(*wargs[0], {"f": state["f"]})
+                },
+                interior=lambda args, wargs, state, dt, j: fn(
+                    wargs[1], wargs[2], state, dt, j
+                ),
+                budget=plan.budget,
+                args=(wrings, wt, vbT),
+                local_mask=plan.local_mask,
+            )
+        self._wide_cached = (self.grid.epoch, spec)
+        return spec
+
     def batch_step_spec(self):
         """Cohort-batchable step entry point (ISSUE 9; see
         ``Advection.batch_step_spec``).  ``nv`` rides the kernel key:
@@ -546,6 +707,7 @@ class Vlasov:
                 args=(), dt_dtype=dtype, steps_per_dispatch=k,
             )
         ex = self._exchange
+        wide = self._wide_spec()
         if self.overlap:
             fn = self._split_fn_k
             return BatchStepSpec(
@@ -554,7 +716,7 @@ class Vlasov:
                             str(dtype), self._has_open, self.nv),
                 call=lambda args, state, dt: fn(*args, state, dt),
                 args=self._split_args, dt_dtype=dtype,
-                steps_per_dispatch=k,
+                steps_per_dispatch=k, wide=wide,
             )
         fn = self._gen_fn
         return BatchStepSpec(
@@ -563,6 +725,7 @@ class Vlasov:
                         self._has_open, self.nv),
             call=lambda args, state, dt: fn(*args, state, dt),
             args=self._gen_args, dt_dtype=dtype, steps_per_dispatch=k,
+            wide=wide,
         )
 
     def _record_run(self, path: str, steps, state) -> None:

@@ -57,6 +57,9 @@ from ..obs.registry import metrics as _metrics
 __all__ = [
     "ExecutableCache",
     "BatchStepSpec",
+    "WideStepSpec",
+    "run_donate_enabled",
+    "record_run_donation",
     "cohort_key",
     "default_steps_per_dispatch",
     "max_steps_per_dispatch",
@@ -115,6 +118,67 @@ class BatchStepSpec(NamedTuple):
     args: tuple = ()
     dt_dtype: object = None
     steps_per_dispatch: int = 1
+    #: optional :class:`WideStepSpec` — the exchange-amortized split of
+    #: ``call`` (ISSUE 14).  None keeps the exchange-every-step body.
+    wide: object = None
+
+
+class WideStepSpec(NamedTuple):
+    """Exchange-amortized split of a member step (ISSUE 14, "wide halo").
+
+    ``call`` fuses exchange + interior update; this spec splits them so a
+    deep-dispatch cohort body can pay ONE depth-g exchange per g interior
+    steps instead of one per step:
+
+    * ``exchange`` — ``exchange(args, wargs, state) -> state``: refill the
+      full default-hood ghost zone (the model's field subset) once.
+    * ``interior`` — ``interior(args, wargs, state, dt, j) -> state``: one
+      interior step at loop index j since the last exchange, updating
+      every row whose ``steps_ok`` exceeds j (the shrinking valid region)
+      and freezing the stale fringe.  Local rows are bit-identical to the
+      fused ``call`` at every j below ``budget``.
+    * ``budget`` — interior steps one exchange funds before OWNED rows go
+      stale (min ``steps_ok`` over local rows); the scheduler clamps k to
+      it so a dispatch is exactly one exchange.
+    * ``args`` — the wide runtime-argument pytree (full-hood ring tables,
+      device-extended gather tables, ``steps_ok``, model extras); stacked
+      and content-matched alongside ``BatchStepSpec.args``.
+    * ``local_mask`` — host ``(D, R)`` bool of owner rows: the set the
+      solo-replay oracle byte-compares (ghost rows legitimately hold
+      stale or fringe-recomputed values between exchanges).
+    """
+
+    exchange: object
+    interior: object
+    budget: int
+    args: tuple = ()
+    local_mask: object = None
+
+
+def run_donate_enabled() -> bool:
+    """Whether the solo model ``run()`` kernels donate their input state
+    buffers (``DCCRG_RUN_DONATE``, default OFF — solo callers commonly
+    reuse the pre-run state, which donation invalidates; the ensemble's
+    stacked state donates via ``DCCRG_ENSEMBLE_DONATE`` instead).
+    Effectiveness is measured, not assumed: the first donated dispatch
+    probes ``is_deleted`` on the input buffer and gauges
+    ``run.donate_effective``."""
+    return os.environ.get("DCCRG_RUN_DONATE", "0").lower() in (
+        "1", "true", "on",
+    )
+
+
+def record_run_donation(model: str, probe) -> None:
+    """After a donated solo ``run()`` dispatch: gauge whether the input
+    buffer was actually consumed.  ``is_deleted`` on the pre-dispatch
+    leaf is the ground truth (the ensemble's ``DCCRG_ENSEMBLE_DONATE``
+    uses the same probe) — backends are free to ignore donation (CPU
+    commonly does), so effectiveness is a measurement, not a promise."""
+    try:
+        eff = 1.0 if probe.is_deleted() else 0.0
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        eff = 0.0
+    _metrics.gauge("run.donate_effective", eff, model=model)
 
 
 def max_steps_per_dispatch() -> int:
@@ -142,7 +206,8 @@ def default_steps_per_dispatch() -> int:
 
 def cohort_key(spec: "BatchStepSpec", width: int,
                steps_per_dispatch: int | None = None,
-               shared_args: bool = False, donate: bool = False) -> tuple:
+               shared_args: bool = False, donate: bool = False,
+               wide_g: int = 0) -> tuple:
     """Executable-cache key of a cohort-batched step body: the member
     program's identity plus everything else the batched trace (or its
     buffer-aliasing contract) depends on — the stacked leading-axis
@@ -150,13 +215,16 @@ def cohort_key(spec: "BatchStepSpec", width: int,
     static, so each depth is one compile: changing ONLY k at a held
     (signature, width) costs exactly one new body), whether the
     runtime-argument tables are broadcast-shared (vmap ``in_axes=None``
-    — a different traced program from the per-member stack) and whether
-    the stacked state is donated.  Occupancy churn at a held key
-    re-dispatches, never retraces."""
+    — a different traced program from the per-member stack), whether
+    the stacked state is donated, and the wide-halo exchange depth g
+    (0 = exchange-every-step; a wide body's block structure
+    ``ceil(k/g)`` is static, so changing ONLY g at a held
+    (signature, W, k) compiles exactly one new body).  Occupancy churn
+    at a held key re-dispatches, never retraces."""
     k = int(spec.steps_per_dispatch if steps_per_dispatch is None
             else steps_per_dispatch)
     return ("ensemble.step", spec.kind, spec.kernel_key, int(width),
-            max(k, 1), bool(shared_args), bool(donate))
+            max(k, 1), bool(shared_args), bool(donate), int(wide_g))
 
 
 def mesh_key(mesh):

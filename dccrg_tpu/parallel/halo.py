@@ -36,7 +36,8 @@ from .exec_cache import ExecutableCache, mesh_key as _mesh_key, traced_jit
 from .mesh import SHARD_AXIS, put_table
 from .shapes import bucket_pairs
 
-__all__ = ["HaloExchange", "HaloHandle", "interior_steps_per_exchange"]
+__all__ = ["HaloExchange", "HaloHandle", "interior_steps_per_exchange",
+           "record_dispatch_exchanges"]
 
 
 def interior_steps_per_exchange(ghost_depth: int,
@@ -63,6 +64,32 @@ def interior_steps_per_exchange(ghost_depth: int,
     depth = max(int(ghost_depth), 0)
     radius = max(int(stencil_radius), 1)
     return max(depth // radius, 1)
+
+
+#: model kind -> [exchanges, steps]: cumulative dispatch-level exchange
+#: amortization, fed by the serving tier (ISSUE 14)
+_amortization: dict = {}
+
+
+def record_dispatch_exchanges(kind: str, exchanges: int, steps: int) -> None:
+    """Host-side exchange-amortization ledger for deep dispatch.
+
+    In-trace exchanges are intentionally invisible to ``_record`` (it
+    would count trace-time, not run-time), so the cohort front-end
+    reports its OWN protocol here after each dispatch: a wide-halo body
+    at depth g pays ``ceil(k / g)`` exchanges for k simulated steps, the
+    legacy body pays k.  The cumulative ratio lands as the
+    ``halo.exchanges_per_step`` gauge — the ISSUE 14 headline series
+    (~1/k when the scheduler clamps k inside the exchange budget, 1.0 on
+    the exchange-every-step path), CEILING-gated by ``telemetry_diff``.
+    Pure python-int arithmetic: safe from the dispatch hot path."""
+    steps = int(steps)
+    if steps <= 0:
+        return
+    ent = _amortization.setdefault(kind, [0, 0])
+    ent[0] += int(exchanges)
+    ent[1] += steps
+    _metrics.gauge("halo.exchanges_per_step", ent[0] / ent[1], model=kind)
 
 #: process-wide fallback cache for exchanges constructed without a grid
 #: (tests, ad-hoc schedules) — grid-owned exchanges share the grid's own

@@ -84,6 +84,28 @@ fleet.  This module is the front-end that exploits it:
   earliest member deadline's slack (a tight deadline must not wait out
   a 16-step block it only needed 2 steps of).
 
+* **Exchange amortization** (ISSUE 14): deep dispatch amortized the
+  HOST round-trip, but every interior step of the k-loop still ran a
+  full halo exchange.  When a member program ships a
+  :class:`~dccrg_tpu.parallel.exec_cache.WideStepSpec` (a depth-g
+  default-hood ghost zone whose gather tables cover every replica row,
+  plus the ``steps_ok`` staleness ledger — ``parallel/wide_halo.py``),
+  the cohort body becomes ``ceil(k/g)`` blocks of [one exchange, then
+  up to g interior steps]: each interior step consumes one
+  stencil-radius shell of the exchanged zone, recomputing the shrinking
+  ghost fringe redundantly instead of re-exchanging, and the next block
+  refills.  g is static per compiled body (``cohort_key`` carries
+  ``wide_g`` — changing only g compiles exactly one new body) and
+  :meth:`Scheduler.select_k` clamps scheduled depths to the exchange
+  budget so a scheduled dispatch pays exactly ONE exchange; the
+  host-side ``halo.exchanges_per_step`` gauge (ceiling-gated) records
+  the amortization — ~1/k when wide halos engage, 1.0 legacy.
+  Correctness anchor unchanged: owner-local rows are bit-identical to
+  exchange-every-step stepping (the wide gather tables keep the
+  owner's slot order and ``ordered_sum`` association chain), so the
+  solo-replay oracle still byte-compares them — ghost replica rows are
+  the only rows allowed to go stale, and only inside a block.
+
 * **Buffer donation**: the stacked cohort state is donated to the step
   body (``donate_argnums`` — the jit aliases input and output buffers)
   so XLA stops materializing a second copy of the fleet state every
@@ -139,7 +161,9 @@ from ..parallel.exec_cache import (
     max_steps_per_dispatch,
     traced_jit,
 )
+from ..parallel.halo import record_dispatch_exchanges
 from ..parallel.mesh import SHARD_AXIS
+from ..parallel.wide_halo import halo_depth_cap, wide_enabled
 
 # the request-latency series resolve finer than the octave default so
 # exported p99 estimates sit within one ~9% bucket (obs/slo.py); same
@@ -264,6 +288,20 @@ class Scenario:
         return max(self.steps - self.steps_done, 0)
 
 
+def _wide_of(spec):
+    """The spec's :class:`WideStepSpec` when exchange amortization
+    engages for it, else None.  Engagement needs a wide plan (the model
+    found a usable depth-g ghost zone), the process-wide
+    ``DCCRG_ENSEMBLE_WIDE`` switch, and a budget of at least 2 interior
+    steps — one exchange funding one step is exactly the legacy body,
+    so budget-1 plans stay on the per-step path (every hood-0 grid
+    lands here, unchanged)."""
+    wide = getattr(spec, "wide", None)
+    if wide is not None and wide_enabled() and int(wide.budget) >= 2:
+        return wide
+    return None
+
+
 def _state_sig(state) -> tuple:
     """Hashable structure+shape+dtype identity of a state pytree — the
     defensive refinement of the cohort key (equal kernel keys imply
@@ -319,10 +357,24 @@ class Cohort:
         self._remaining = np.zeros(self.W, np.int64)
         self._occupied = np.zeros(self.W, bool)
         self._dts = np.zeros(self.W, self.dt_dtype)
+        #: the member program's wide-halo plan when exchange
+        #: amortization engages for this cohort (ISSUE 14), else None —
+        #: the cohort then carries the wide exchange/interior tables
+        #: alongside the legacy ones and its deep bodies pay ceil(k/g)
+        #: exchanges instead of k
+        self._wide = _wide_of(spec)
+        #: min exchange budget over admitted members: the deepest g any
+        #: dispatch may run before some member's OWNED rows would go
+        #: stale (heterogeneous same-signature joiners can lower it)
+        self._wide_budget = (int(self._wide.budget)
+                             if self._wide is not None else 0)
         #: the template member's runtime tables, kept as submitted
         #: (host refs): the content key joiners are checked against in
-        #: shared mode, and the stacking source on promotion
-        self._args_src = spec.args
+        #: shared mode, and the stacking source on promotion.  With
+        #: wide halos engaged this is the COMBINED (legacy, wide)
+        #: pytree — both table sets ride the same stack/share/admit
+        #: machinery
+        self._args_src = self._combined_args(spec)
         self.shared_args = (shared_tables_enabled() if shared is None
                             else bool(shared))
         if self.shared_args:
@@ -330,12 +382,13 @@ class Cohort:
             # members of one model instance carry byte-identical
             # tables, so stacking W copies only burned HBM
             self._args = jax.tree_util.tree_map(
-                lambda x: self._put_member(jnp.asarray(x)), spec.args,
+                lambda x: self._put_member(jnp.asarray(x)),
+                self._args_src,
             )
         else:
             self._args = jax.tree_util.tree_map(
                 lambda x: self._put(jnp.stack([jnp.asarray(x)] * self.W)),
-                spec.args,
+                self._args_src,
             )
         # stacked state: slot 0's values replicated as padding (pad
         # slots are masked, their contents only need to be
@@ -390,23 +443,50 @@ class Cohort:
         except Exception:  # noqa: BLE001 — fall back to default placement
             return leaf
 
+    def _combined_args(self, spec) -> object:
+        """The runtime-table pytree one member contributes: the legacy
+        tables alone, or the ``(legacy, wide)`` pair when this cohort
+        runs wide-halo bodies — combining them lets stacking, admission
+        content-checks, ``set_slot`` writes and promotion treat both
+        table sets as one tree."""
+        if self._wide is None:
+            return spec.args
+        return (spec.args, spec.wide.args)
+
+    def _wide_g(self, k: int) -> int:
+        """Exchange depth for a depth-``k`` dispatch: how many interior
+        steps each exchange funds.  Clamped to the cohort's member-min
+        budget and ``DCCRG_HALO_DEPTH``; below 2 the wide body IS the
+        legacy body, so 0 (disengaged) is returned instead."""
+        if self._wide is None:
+            return 0
+        g = min(int(k), self._wide_budget, halo_depth_cap())
+        return g if g >= 2 else 0
+
     def _kernel_for(self, k: int):
         """The compiled depth-``k`` cohort body, via the grid's
         executable cache: one body per (kernel_key, W, k, shared,
-        donate) — occupancy churn at a held key re-dispatches, a new
-        depth compiles exactly one new body."""
+        donate, wide_g) — occupancy churn at a held key re-dispatches,
+        a new depth (or a new exchange depth g) compiles exactly one
+        new body."""
         k = max(int(k), 1)
-        kern = self._kernels.get(k)
+        g = self._wide_g(k)
+        # a wide cohort's legacy-depth body (g clamped under 2) still
+        # destructures the combined (legacy, wide) args pytree — it
+        # must never share a cache entry with a plain cohort's body at
+        # the same (kernel_key, W, k), so its key carries -1, not 0
+        key_g = g if g else (-1 if self._wide is not None else 0)
+        kern = self._kernels.get((k, g))
         if kern is None:
             kern = self.exec_cache.get(
                 cohort_key(self.spec, self.W, k, self.shared_args,
-                           self._donate),
-                lambda: self._build_kernel(k),
+                           self._donate, wide_g=key_g),
+                lambda: self._build_kernel(k, g),
             )
-            self._kernels[k] = kern
+            self._kernels[(k, g)] = kern
         return kern
 
-    def _build_kernel(self, k: int):
+    def _build_kernel(self, k: int, g: int = 0):
         """The compiled cohort body: vmap of the member program over the
         stacked leading axis (tables broadcast via ``in_axes=None`` in
         shared mode), inactive slots frozen by the runtime occupancy
@@ -417,12 +497,27 @@ class Cohort:
         ever overshoots its requested steps.  The stacked state is
         donated (when enabled) so the dispatch aliases instead of
         copying it; ``remaining``/``dts``/``mask`` are runtime
-        arguments, so neither budgets nor occupancy ever retrace."""
+        arguments, so neither budgets nor occupancy ever retrace.
+
+        Exchange depth ``g >= 2`` (ISSUE 14) replaces the per-step body
+        with ``ceil(k/g)`` unrolled blocks of [one wide exchange, then
+        a ``fori_loop`` of up to g interior steps]: interior step j
+        updates exactly the rows whose ``steps_ok`` exceeds j (every
+        owned row, by the budget clamp) and freezes the stale ghost
+        fringe at its exchanged values.  The split-phase DMA structure
+        stays at PROGRAM level inside the wide exchange, exactly as in
+        the member program (jax 0.4.x cannot split start/wait across
+        ``pallas_call`` boundaries)."""
         import jax
         import jax.numpy as jnp
 
         spec = self.spec
-        call = spec.call
+        wide = self._wide if g >= 2 else None
+        # with wide halos engaged the cohort args are the combined
+        # (legacy, wide) pair even when a particular body runs legacy
+        # (k=1, or g clamped under 2) — those bodies destructure
+        call = (spec.call if self._wide is None
+                else lambda a, s, d: spec.call(a[0], s, d))
         in_axes = (None, 0, 0) if self.shared_args else (0, 0, 0)
         donate = (1,) if self._donate else ()
 
@@ -433,7 +528,29 @@ class Cohort:
 
             return jax.tree_util.tree_map(freeze, new, old)
 
-        if k == 1:
+        if wide is not None:
+            wax = None if self.shared_args else 0
+            vex = jax.vmap(wide.exchange, in_axes=(wax, wax, 0))
+            vin = jax.vmap(wide.interior, in_axes=(wax, wax, 0, 0, None))
+
+            def cohort_step(args, state, remaining, dts, mask):
+                largs, wargs = args
+                st = state
+                for lo in range(0, k, g):
+                    # one depth-g exchange funds this whole block; the
+                    # per-member budgets freeze slots exactly as the
+                    # legacy loop does, exchange included
+                    st = freeze_tree(mask & (remaining > lo),
+                                     vex(largs, wargs, st), st)
+
+                    def one(i, s, lo=lo):
+                        stepped = vin(largs, wargs, s, dts, i)
+                        return freeze_tree(mask & (remaining > lo + i),
+                                           stepped, s)
+
+                    st = jax.lax.fori_loop(0, min(g, k - lo), one, st)
+                return st
+        elif k == 1:
             def cohort_step(args, state, remaining, dts, mask):
                 stepped = jax.vmap(call, in_axes=in_axes)(args, state,
                                                           dts)
@@ -551,7 +668,12 @@ class Cohort:
         return (scenario.spec is not None
                 and scenario.spec.kind == self.spec.kind
                 and scenario.spec.kernel_key == self.spec.kernel_key
-                and _state_sig(scenario.state) == self.state_sig)
+                and _state_sig(scenario.state) == self.state_sig
+                # wide-halo engagement must agree: the combined args
+                # pytree (and so every compiled body) has a different
+                # structure when the wide tables ride along
+                and (_wide_of(scenario.spec) is None)
+                == (self._wide is None))
 
     def free_slots(self) -> np.ndarray:
         return np.flatnonzero(~self._occupied)
@@ -572,8 +694,16 @@ class Cohort:
         slot = int(slot)
         if self._occupied[slot]:
             raise ValueError(f"slot {slot} already occupied")
-        if self.shared_args and not self._args_match(scenario.spec.args):
+        joiner_args = self._combined_args(scenario.spec)
+        if self.shared_args and not self._args_match(joiner_args):
             self.promote_to_stacked()
+        if self._wide is not None:
+            # a heterogeneous joiner may fund fewer interior steps per
+            # exchange than the template: the cohort's dispatch depth g
+            # drops to the member minimum (one new body, like a depth
+            # change — never a wrong row)
+            self._wide_budget = min(self._wide_budget,
+                                    int(scenario.spec.wide.budget))
         self.members[slot] = scenario
         self._occupied[slot] = True
         self._remaining[slot] = scenario.remaining
@@ -582,7 +712,7 @@ class Cohort:
         set_slot = lambda S, x: S.at[slot].set(x)
         if not self.shared_args:
             self._args = jax.tree_util.tree_map(
-                set_slot, self._args, scenario.spec.args
+                set_slot, self._args, joiner_args
             )
         self._state = jax.tree_util.tree_map(
             set_slot, self._state, scenario.state
@@ -643,6 +773,7 @@ class Cohort:
         if n == 0:
             return 0
         k = self.k if k is None else max(int(k), 1)
+        g = self._wide_g(k)
         kernel = self._kernel_for(k)
         #: per-member steps this dispatch really advances (the in-loop
         #: clamp mirrors this on device)
@@ -674,6 +805,12 @@ class Cohort:
                 self._state = kernel(self._args, self._state, rdev,
                                      dts, mdev)
         dt_wall = time.perf_counter() - t0
+        # exchange-amortization accounting (host-side: the in-trace
+        # exchanges are invisible to the halo instrumentation) — a wide
+        # body pays ceil(k/g) exchanges for its k interior steps, the
+        # legacy body pays k; pure python ints, no device sync
+        record_dispatch_exchanges(
+            self.spec.kind, (k + g - 1) // g if g else k, k)
         if donated_probe is not None:
             # measured donation effectiveness: a really-donated input
             # buffer is invalidated at dispatch (CPU backends copy
@@ -738,13 +875,31 @@ class Cohort:
         byte-compare every field of its cohort row.  Mismatches are
         counted, never raised; the sample rotates round-robin over
         active slots so every member is eventually audited.  Returns
-        the mismatch count (tests read it)."""
+        the mismatch count (tests read it).
+
+        With wide halos engaged the replay IS the exchange-every-step
+        oracle the amortized body must match — on OWNED rows.  Ghost
+        replica rows legitimately hold block-stale values (that is the
+        amortization), so state leaves carrying a per-row device axis
+        (``leaf.shape[:2]`` matches the plan's ``local_mask``) are
+        compared on local rows only; every other leaf stays a full
+        byte-compare."""
         import jax
 
         t0 = time.perf_counter()
         take = lambda S: S[slot]
         member_args = (self._args if self.shared_args
                        else jax.tree_util.tree_map(take, self._args))
+        local_mask = None
+        if self._wide is not None:
+            member_args = member_args[0]
+            # the audited member's OWN local rows (a heterogeneous
+            # joiner's row layout differs from the template's): ghost
+            # and pad rows are the ones allowed to diverge
+            member = self.members[slot]
+            wide = (member.spec.wide if member is not None
+                    else self._wide)
+            local_mask = np.asarray(wide.local_mask)
         dt = self.dt_dtype.type(self._dts[slot])
         solo = member_pre
         for _ in range(max(nsteps, 1)):
@@ -755,7 +910,11 @@ class Cohort:
         got_l = jax.tree_util.tree_leaves(got)
         mismatches = 0
         for i, (a, b) in enumerate(zip(solo_l, got_l)):
-            if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+            av, bv = np.asarray(a), np.asarray(b)
+            if (local_mask is not None
+                    and av.shape[:2] == local_mask.shape):
+                av, bv = av[local_mask], bv[local_mask]
+            if av.tobytes() != bv.tobytes():
                 mismatches += 1
                 labels = {"field": names[i]} if names else {}
                 metrics.inc("ensemble.verify_mismatches", **labels)
@@ -1018,11 +1177,21 @@ class Scheduler:
           measured per-step time EMA (a tight-deadline member must not
           sit out a deep block it only needed the first steps of —
           depth trades dispatch overhead against retirement latency,
-          and slack is the budget for that trade).
+          and slack is the budget for that trade);
+        * to the cohort's exchange budget when wide halos engage
+          (ISSUE 14) — a scheduled dispatch then pays exactly ONE
+          exchange (``ceil(k/g) == 1``), which is the whole point of
+          the amortization.  A direct ``cohort.step(k)`` past the
+          budget still works (the body runs multiple exchange blocks);
+          this clamp is the scheduler preferring more dispatches at
+          full amortization over fewer at partial.
         """
         k = (self.steps_per_dispatch
              if self.steps_per_dispatch is not None else cohort.k)
         k = max(1, min(int(k), max_steps_per_dispatch()))
+        if cohort._wide is not None:
+            k = min(k, max(1, min(cohort._wide_budget,
+                                  halo_depth_cap())))
         active = cohort.active_mask()
         if active.any():
             k = min(k, int(cohort._remaining[active].max()))

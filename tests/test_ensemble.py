@@ -403,7 +403,7 @@ def test_verify_oracle_detects_tampering():
         out = kernel(args, state, remaining, dts, mask)
         return {**out, "is_alive": out["is_alive"] ^ 1}
 
-    cohort._kernels[1] = tampered
+    cohort._kernels[(1, 0)] = tampered
     m0 = obs.metrics.counter_value("ensemble.verify_mismatches",
                                    field="is_alive")
     cohort.step()                                # counted, not raised

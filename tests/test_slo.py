@@ -376,7 +376,7 @@ def test_verify_mismatch_dumps_black_box(tmp_path):
         # corrupt the cohort BODY: its output diverges from the solo
         # member program, which is exactly what the oracle audits
         kernel = cohort._kernel_for(1)
-        cohort._kernels[1] = lambda args, state, remaining, dts, mask: (
+        cohort._kernels[(1, 0)] = lambda args, state, remaining, dts, mask: (
             jax.tree_util.tree_map(
                 lambda S: S + S.dtype.type(1),
                 kernel(args, state, remaining, dts, mask),

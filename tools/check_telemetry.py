@@ -863,6 +863,121 @@ def _ensemble_probe() -> list:
     return failures
 
 
+def _wide_halo_probe() -> list:
+    """Exchange-amortized deep dispatch round (ISSUE 14): a k=4 wide
+    round on a depth-4 ghost zone must pay ONE exchange per dispatch —
+    the ``halo.exchanges_per_step`` gauge (the ceiling-gated headline)
+    reads exactly 1/4 — with the solo-replay oracle armed and clean,
+    and a second wave at the held (signature, width, k, g) must
+    recompile NOTHING.  Returns failure strings."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.parallel import halo
+    from dccrg_tpu.serve import Ensemble
+
+    failures: list = []
+    try:
+        n = 6
+        g = (
+            Grid()
+            .set_initial_length((n, n, n))
+            .set_neighborhood_length(4)
+            .set_periodic(True, True, True)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / n,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        g.stop_refining()
+        moore = [(i, j, k) for i in (-1, 0, 1) for j in (-1, 0, 1)
+                 for k in (-1, 0, 1) if (i, j, k) != (0, 0, 0)]
+        g.add_neighborhood(7, moore)
+        gol = GameOfLife(g, hood_id=7, allow_dense=False)
+        spec = gol.batch_step_spec()
+        if spec.wide is None or spec.wide.budget < 4:
+            failures.append(
+                "wide-halo probe: no engageable wide plan on a depth-4 "
+                f"hood (wide={spec.wide!r}); exchange amortization "
+                "cannot run"
+            )
+            return failures
+        cells = g.get_cells()
+        rng = np.random.default_rng(0)
+        mk = lambda: gol.new_state(
+            alive_cells=cells[rng.random(len(cells)) < 0.3]
+        )
+
+        def recompiles() -> int:
+            rep = obs.metrics.report()
+            return int(sum(rep["counters"].get("epoch.recompiles", {})
+                           .values()))
+
+        halo._amortization.clear()
+        ens = Ensemble(verify=True, steps_per_dispatch=4)
+        first = [mk() for _ in range(4)]
+        tickets = [ens.submit(gol, s, steps=8, tenant="wide")
+                   for s in first]
+        ens.run()                            # warms the (k=4, g=4) body
+        before = recompiles()
+        for s in (mk() for _ in range(4)):   # churn at held (W, k, g)
+            ens.submit(gol, s, steps=4, tenant="wide")
+        ens.run()
+        if recompiles() != before:
+            failures.append(
+                f"wide-halo probe: churn at a held (signature, width, "
+                f"k, g) recompiled {recompiles() - before} kernel(s); "
+                "the wide cohort body must re-dispatch from cache"
+            )
+        rep = obs.metrics.report()
+        gauge = rep["gauges"].get("halo.exchanges_per_step", {})
+        got = gauge.get("model=gol")
+        if got != 0.25:
+            failures.append(
+                f"wide-halo probe: halo.exchanges_per_step = {got!r} "
+                "after k=4 wide rounds; one exchange must fund 4 "
+                "interior steps (wanted 0.25)"
+            )
+        checks = sum(rep["counters"].get("ensemble.verify_checks", {})
+                     .values())
+        if checks < 2:
+            failures.append(
+                f"wide-halo probe: verify oracle ran {checks} checks; "
+                "the armed wide round must replay sampled members"
+            )
+        mism = sum(rep["counters"].get("ensemble.verify_mismatches", {})
+                   .values())
+        if mism:
+            failures.append(
+                f"wide-halo probe: {mism} cohort/solo mismatches — the "
+                "amortized body is no longer bit-identical to exchange-"
+                "every-step stepping on owned rows"
+            )
+        # owned-row bit-identity against solo, independent of the oracle
+        import jax  # noqa: F401 — tree flatten below
+
+        ref = first[0]
+        for _ in range(8):
+            ref = gol.step(ref)
+        lm = spec.wide.local_mask
+        for name in sorted(ref):
+            a = np.asarray(ref[name])
+            b = np.asarray(tickets[0].result[name])
+            if a.shape[:2] == lm.shape:
+                a, b = a[lm], b[lm]
+            if a.tobytes() != b.tobytes():
+                failures.append(
+                    f"wide-halo probe: field {name!r} diverged from 8 "
+                    "solo steps on owned rows"
+                )
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"wide-halo probe failed: {e!r}")
+    return failures
+
+
 def _slo_probe() -> list:
     """Request-level SLO round (ISSUE 10).
 
@@ -1146,6 +1261,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     failures += _churn_probe(g, dt)
     failures += _halo_backend_probe()
     failures += _ensemble_probe()
+    failures += _wide_halo_probe()
     failures += _slo_probe()
 
     if not skip_overhead:

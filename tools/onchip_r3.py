@@ -225,6 +225,15 @@ import bench
 out = bench.measure_deep_dispatch()
 print(json.dumps(out))
 """, 1500),
+    # ISSUE 14: exchange-amortized deep dispatch — the wide-halo g×k
+    # sweep; the per-dispatch exchange this amortizes is an ICI
+    # collective on a real mesh, so the wide/legacy ratio measured here
+    # understates the on-chip margin
+    "wide_halo": ("""
+import bench
+out = bench.measure_wide_halo()
+print(json.dumps(out))
+""", 1500),
     "large": ("import bench\nprint(json.dumps(bench.measure_large()))", 1500),
     "flat_kernel_sweep_Bvox_per_s": ("""
 import tools.flat_kernel_bench as fkb

@@ -216,6 +216,12 @@ GATED_GAUGES_MIN = (
 #: rounds carry the gauge; per labeled series (one per model kind).
 GATED_GAUGES_MAX = (
     "ensemble.hbm_bytes_per_member",
+    # ISSUE 14 headline: cumulative exchanges per interior step, ~1/k
+    # with wide halos engaged, 1.0 legacy.  A round where it climbs
+    # past the ceiling means dispatches stopped amortizing the halo
+    # exchange — the regression exchange-amortized deep dispatch
+    # exists to prevent.  Per labeled series (one per model kind).
+    "halo.exchanges_per_step",
 )
 
 
