@@ -538,18 +538,23 @@ def build_boxed_run(adv, layout):
         out_specs=data_spec,
     )
 
+    # the boxed tables ride into the jit as a RUNTIME argument pytree
+    # (not closed over): same-shape boxings share one executable
     @jax.jit
-    def run(state, steps, dt):
+    def run_impl(statics_arg, state, steps, dt):
         dt = jnp.asarray(dt, dtype)
         steps = jnp.asarray(steps, jnp.int32)
         density = sm(
             state["density"], state["vx"], state["vy"], state["vz"],
-            dt, steps, statics_dev,
+            dt, steps, statics_arg,
         )
         return {
             **state,
             "density": density,
             "flux": jnp.zeros_like(state["flux"]),
         }
+
+    def run(state, steps, dt):
+        return run_impl(statics_dev, state, steps, dt)
 
     return run

@@ -52,6 +52,22 @@ seam instead:
   (``DCCRG_FLIGHTREC``, ``DCCRG_FLIGHTREC_DIR``,
   ``DCCRG_FLIGHTREC_CAP``; ``tools/slo_report.py`` is the read side).
 
+* the LIVE side of that plane (ISSUE 16): ``obs.live`` tails the
+  per-process ``*.stream.jsonl`` files across a fleet (byte-offset
+  resume, torn-tail tolerance, seq-gap counting) and serves sliding-
+  window views — windowed rates, windowed p50/p95/p99 via bucket-delta
+  subtraction, per-tenant deadline-miss rates — through
+  :class:`~dccrg_tpu.obs.live.FleetAggregator` /
+  :class:`~dccrg_tpu.obs.live.FleetView`, plus a Prometheus text
+  exposition; ``obs.alerts`` evaluates declarative
+  :class:`~dccrg_tpu.obs.alerts.AlertRule` predicates (ceiling/floor,
+  ``for_s`` duration-to-fire, hysteresis clear) over those views,
+  counts firings, lands incidents on the timeline, dumps the flight
+  recorder once per incident, and feeds the supervisor's escalation
+  ladder (``DCCRG_LIVE_WINDOW_S``, ``DCCRG_ALERTS``,
+  ``DCCRG_ALERT_RULES``, ``DCCRG_STREAM_FLUSH_S``;
+  ``tools/fleet_top.py`` and ``slo_report.py --live`` are the consoles).
+
 Telemetry is on by default (the recording sites are per-epoch or
 per-host-dispatch, never inside device loops); ``disable()`` — or
 ``DCCRG_TELEMETRY=0`` in the environment — makes every recording call a
@@ -61,7 +77,7 @@ can be switched off independently (``DCCRG_TIMELINE=0``).
 from .registry import MetricsRegistry, metrics, disable, enable
 from .export import export_json
 from .trace import profile_trace, trace_span
-from .stream import TelemetryStream, stream_to
+from .stream import TelemetryStream, stream_to, maybe_flush
 from .events import (
     EventTimeline,
     timeline,
@@ -73,6 +89,8 @@ from .events import (
 from .hbm import sample_hbm
 from . import fused
 from . import slo
+from . import live
+from . import alerts
 from . import xplane
 from .flightrec import (
     FlightRecorder,
@@ -99,6 +117,7 @@ __all__ = [
     "trace_span",
     "TelemetryStream",
     "stream_to",
+    "maybe_flush",
     "EventTimeline",
     "timeline",
     "span",
@@ -108,6 +127,8 @@ __all__ = [
     "sample_hbm",
     "fused",
     "slo",
+    "live",
+    "alerts",
     "xplane",
     "FlightRecorder",
     "flight_recorder",

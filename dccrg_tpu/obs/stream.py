@@ -26,10 +26,47 @@ import json
 import os
 import threading
 import time
+import weakref
 
 from .registry import metrics
 
-__all__ = ["TelemetryStream", "stream_to"]
+__all__ = ["TelemetryStream", "stream_to", "maybe_flush"]
+
+#: every STARTED stream, weakly held — the step-boundary flush seam
+#: (``maybe_flush``) walks it so live windows move between timer ticks
+_active: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _flush_period() -> float:
+    """``DCCRG_STREAM_FLUSH_S``: minimum seconds between step-boundary
+    snapshots (default 1.0; <= 0 disables the seam entirely)."""
+    try:
+        return float(os.environ.get("DCCRG_STREAM_FLUSH_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def maybe_flush(now: float | None = None) -> int:
+    """Write a snapshot on every active stream whose last line is older
+    than ``DCCRG_STREAM_FLUSH_S``.  Called from step boundaries (the
+    ensemble scheduler) so live tailers see fresh windows even when the
+    periodic ticker is slow; a cheap no-op when no stream is active.
+    Returns the number of snapshots written; never raises."""
+    if not _active:
+        return 0
+    period = _flush_period()
+    if period <= 0:
+        return 0
+    now = time.time() if now is None else float(now)
+    n = 0
+    for s in tuple(_active):
+        try:
+            if now - s._last_ts >= period:
+                s.write_snapshot()
+                n += 1
+        except Exception:  # noqa: BLE001 — never kill the workload
+            pass
+    return n
 
 
 class TelemetryStream:
@@ -87,6 +124,7 @@ class TelemetryStream:
                              name="dccrg-telemetry-stream")
         self._thread = t
         t.start()
+        _active.add(self)
         return self
 
     def _loop(self) -> None:
@@ -98,6 +136,7 @@ class TelemetryStream:
 
     def stop(self, final: bool = True) -> None:
         """Stop the ticker; ``final`` appends one last snapshot."""
+        _active.discard(self)
         self._stop_evt.set()
         t, self._thread = self._thread, None
         if t is not None:

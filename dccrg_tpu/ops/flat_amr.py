@@ -634,16 +634,17 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
     )
 
     # the Tables seam (parallel/mesh.put_table): sharded device arrays
-    # under one controller, host numpy under many — run_fn's jit closes
-    # over these, and closing over arrays spanning other processes'
-    # devices is rejected by JAX
+    # under one controller, host numpy under many — the tables enter
+    # the jitted body as RUNTIME arguments (same-shape tables share one
+    # executable; closing over arrays spanning other processes' devices
+    # is rejected by JAX)
     statics = tuple(put_table(tables[k], mesh) for k in
                     ("rows", "leaf_fine", "leaf_ext", "wb_rows", "wb_valid"))
 
     @jax.jit
-    def run_fn(state, steps, dt):
+    def run_impl(statics_arg, state, steps, dt):
         rho = sm(
-            *statics,
+            *statics_arg,
             state["density"], state["vx"], state["vy"], state["vz"],
             jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
         )
@@ -652,6 +653,9 @@ def make_flat_amr_run_sharded(grid, tables, dtype=jnp.float32):
             "density": rho.astype(state["density"].dtype),
             "flux": jnp.zeros_like(state["flux"]),
         }
+
+    def run_fn(state, steps, dt):
+        return run_impl(statics, state, steps, dt)
 
     return run_fn
 
@@ -966,10 +970,12 @@ def make_flat_ml_run(grid, tables, dtype=jnp.float32):
         put_table(tables["wb_valid"], mesh),
     )
 
+    # tables as runtime args (not closed over): same-shape meshes reuse
+    # the executable and multi-controller tables stay legal
     @jax.jit
-    def run_fn(state, steps, dt):
+    def run_impl(statics_arg, state, steps, dt):
         rho = sm(
-            *statics,
+            *statics_arg,
             state["density"], state["vx"], state["vy"], state["vz"],
             jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
         )
@@ -978,6 +984,9 @@ def make_flat_ml_run(grid, tables, dtype=jnp.float32):
             "density": rho.astype(state["density"].dtype),
             "flux": jnp.zeros_like(state["flux"]),
         }
+
+    def run_fn(state, steps, dt):
+        return run_impl(statics, state, steps, dt)
 
     return run_fn
 

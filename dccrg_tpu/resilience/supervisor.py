@@ -203,10 +203,25 @@ class Supervisor:
     """
 
     def __init__(self, monitor: HeartbeatMonitor, *, child_alive=None,
-                 ladder: EscalationLadder | None = None):
+                 ladder: EscalationLadder | None = None, alerts=None):
         self.monitor = monitor
         self.ladder = ladder if ladder is not None else EscalationLadder()
         self._child_alive = child_alive
+        #: optional alert-engine signal source (``obs/alerts.py``
+        #: AlertEngine, or anything with a ``firing()`` name list): a
+        #: live child whose SLO rules are firing is unhealthy even
+        #: while its heartbeat beats, so the ladder climbs instead of
+        #: resetting
+        self._alerts = alerts
+
+    def _firing_alerts(self) -> list:
+        eng = self._alerts
+        if eng is None:
+            return []
+        try:
+            return list(eng.firing())
+        except Exception:  # noqa: BLE001 — signals must not kill polling
+            return []
 
     def poll(self, now: float | None = None) -> dict:
         with metrics.phase("supervisor.poll"):
@@ -221,6 +236,14 @@ class Supervisor:
             status, reason = self.monitor.poll(now)
             if status == "stalled":
                 return {"status": status, "reason": reason,
+                        "action": self.ladder.escalate(reason)}
+            firing = self._firing_alerts()
+            if firing:
+                # the alert engine already black-boxed the incident; the
+                # ladder's own first-rung dump stays armed for the next
+                # heartbeat incident and dedups per incident regardless
+                reason = f"alert:{firing[0]}"
+                return {"status": "degraded", "reason": reason,
                         "action": self.ladder.escalate(reason)}
             if status == "ok":
                 self.ladder.reset()

@@ -150,6 +150,7 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import stream as obs_stream
 from ..obs.events import timeline
 from ..obs.flightrec import recorder as flightrec
 from ..obs.hbm import sample_ensemble_hbm
@@ -849,6 +850,18 @@ class Cohort:
                 ("ensemble.steps_served", v, {"tenant": t})
                 for t, v in served.items()
             ])
+            # per-tenant device-seconds attribution: the dispatch held
+            # every device in the cohort's mesh for dt_wall, so the
+            # fleet bill is dt_wall * devices split by the member-steps
+            # each tenant advanced this dispatch (pure host floats)
+            total_adv = sum(served.values())
+            if total_adv > 0:
+                device_total = dt_wall * self.mesh.size
+                metrics.inc_many([
+                    ("ensemble.device_s", device_total * v / total_adv,
+                     {"tenant": t, "model": self.spec.kind})
+                    for t, v in served.items()
+                ])
             metrics.gauge("ensemble.steps_per_dispatch", k,
                           model=self.spec.kind)
             self._sample_hbm()
@@ -1216,6 +1229,10 @@ class Scheduler:
                 metrics.inc("ensemble.retired")
                 self._account_retirement(scn, cohort)
         self._update_gauges()
+        # step-boundary stream flush: live tailers see windows move
+        # even between the periodic ticker's beats (no-op when no
+        # stream is active or DCCRG_STREAM_FLUSH_S <= 0)
+        obs_stream.maybe_flush()
         return served
 
     def _account_retirement(self, scn: Scenario, cohort: Cohort) -> None:

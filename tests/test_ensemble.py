@@ -588,3 +588,53 @@ def test_queue_depth_driven_rescale_end_to_end():
         np.asarray(r.grid.get_cell_data(r.state, "is_alive", ids)), want)
     ens.run()                                    # drain the backlog
     assert ens.queue_depth() == 0
+
+
+def test_device_seconds_attribution_and_step_boundary_flush(monkeypatch):
+    """ISSUE 16: every cohort dispatch bills ``dt_wall * devices`` to
+    ``ensemble.device_s{tenant, model}`` split by member-steps advanced,
+    and the scheduler's step boundary flushes active telemetry streams
+    (``maybe_flush``) so live tailers see windows move mid-run."""
+    import json as _json
+    import os as _os
+
+    g = make_grid()
+    gol = GameOfLife(g, allow_dense=False)
+    states = gol_states(gol, g, 4, seed=7)
+
+    def tenant_device_s():
+        series = obs.metrics.report()["counters"].get(
+            "ensemble.device_s", {})
+        out = {}
+        for label, v in series.items():
+            kv = dict(p.split("=", 1) for p in label.split(",") if "=" in p)
+            assert kv.get("model"), label  # attribution names the model
+            out[kv["tenant"]] = out.get(kv["tenant"], 0.0) + v
+        return out
+
+    before = tenant_device_s()
+    with tempfile.TemporaryDirectory() as td:
+        path = _os.path.join(td, "ens.stream.jsonl")
+        monkeypatch.setenv("DCCRG_STREAM_FLUSH_S", "0.0001")
+        s = obs.TelemetryStream(path, period=3600.0)
+        s.start()
+        try:
+            ens = Ensemble()
+            for i, st in enumerate(states):
+                ens.submit(gol, st, steps=4,
+                           tenant="alice" if i % 2 == 0 else "bob")
+            ens.run()
+        finally:
+            s.stop(final=False)
+        lines = [ln for ln in open(path) if ln.strip()]
+        # step_once flushed between scheduler rounds, not only at exit
+        assert len(lines) >= 1
+        assert all("histograms" in _json.loads(ln) for ln in lines)
+    after = tenant_device_s()
+    for tenant in ("alice", "bob"):
+        assert after.get(tenant, 0.0) > before.get(tenant, 0.0)
+    # equal member-steps per tenant split the bill evenly (both tenants
+    # advanced 2 members x 4 steps through identical cohort dispatches)
+    d_alice = after["alice"] - before.get("alice", 0.0)
+    d_bob = after["bob"] - before.get("bob", 0.0)
+    assert d_alice == pytest.approx(d_bob, rel=0.6)

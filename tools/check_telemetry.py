@@ -172,13 +172,24 @@ STREAM_REQUIRED_KEYS = ("seq", "ts", "phases", "counters", "gauges",
                         "histograms")
 
 
-def validate_stream(path: str) -> list:
+def validate_stream(path: str, counts: dict | None = None) -> list:
     """Schema-validate a telemetry JSONL stream (``obs.stream_to``
     output); returns failure strings (empty = valid).  A truncated FINAL
     line is tolerated when the file does not end in a newline — that is
     exactly the killed-mid-write case the stream exists to survive — but
-    every complete line must parse and the sequence must be coherent."""
+    every complete line must parse and the sequence must be coherent.
+
+    Anomalies that are tolerated are no longer silent (ISSUE 16): pass
+    a ``counts`` dict and it comes back with ``lines`` (complete
+    snapshot lines), ``seq_gaps`` (missing sequence numbers — lines
+    lost to a partial copy or a writer restarted without truncate) and
+    ``torn_tail`` (1 when the final line was cut mid-write) — the same
+    tallies the live tailer (``obs/live.py``) keeps per file."""
     failures: list = []
+    if counts is None:
+        counts = {}
+    counts.update({"lines": 0, "seq_gaps": 0, "torn_tail": 0,
+                   "bad_lines": 0})
     try:
         with open(path) as f:
             text = f.read()
@@ -192,7 +203,9 @@ def validate_stream(path: str) -> list:
             json.loads(lines[-1])
             body.append(lines[-1])  # complete after all, just no newline
         except json.JSONDecodeError:
-            pass  # killed mid-write: the complete lines carry the evidence
+            # killed mid-write: the complete lines carry the evidence —
+            # tolerated, but COUNTED so a consumer can see it happened
+            counts["torn_tail"] = 1
     if not body:
         return [f"stream {path} holds no complete snapshot line"]
     prev_seq, prev_ts = None, None
@@ -201,11 +214,14 @@ def validate_stream(path: str) -> list:
         try:
             rec = json.loads(ln)
         except json.JSONDecodeError as e:
+            counts["bad_lines"] += 1
             failures.append(f"line {i}: not JSON ({e})")
             continue
         if not isinstance(rec, dict):
+            counts["bad_lines"] += 1
             failures.append(f"line {i}: not an object")
             continue
+        counts["lines"] += 1
         missing = [k for k in STREAM_REQUIRED_KEYS if k not in rec]
         if missing:
             failures.append(f"line {i}: missing keys {missing}")
@@ -214,6 +230,11 @@ def validate_stream(path: str) -> list:
             failures.append(
                 f"line {i}: seq {rec['seq']} not above {prev_seq}"
             )
+        elif prev_seq is not None and rec["seq"] > prev_seq + 1:
+            # strictly increasing but not contiguous: lines are MISSING
+            # (lost to a partial copy, or a writer reopened an existing
+            # file) — coherent enough to consume, counted as gaps
+            counts["seq_gaps"] += rec["seq"] - prev_seq - 1
         if prev_ts is not None and rec["ts"] < prev_ts:
             failures.append(
                 f"line {i}: ts {rec['ts']} went backwards from {prev_ts}"
@@ -1120,6 +1141,231 @@ def _slo_probe() -> list:
     return failures
 
 
+#: the live-probe stream writer: file-loads the registry (stdlib-only
+#: by contract, so the subprocess never pays a jax import), records a
+#: DETERMINISTIC sample schedule into the SLO series at the SLO bucket
+#: resolution, and hand-writes the stream lines — writer 1 additionally
+#: injects a 2-line seq gap and ends on a torn (newline-less) final
+#: line, the anomalies the tailer must count without dropping data
+_LIVE_WRITER_SRC = r"""
+import importlib.util, json, sys, time
+reg_path, out_path, wid = sys.argv[1], sys.argv[2], int(sys.argv[3])
+spec = importlib.util.spec_from_file_location("dccrg_live_reg", reg_path)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+assert "jax" not in sys.modules, "registry file-load imported jax"
+reg = mod.MetricsRegistry(enabled=True)
+reg.set_histogram_resolution("ensemble.e2e_s", 8)
+tenant = "t%d" % wid
+seq = 0
+f = open(out_path, "w")
+def snap():
+    global seq
+    rec = {"seq": seq, "ts": time.time(), **reg.report()}
+    f.write(json.dumps(rec, default=float) + "\n")
+    f.flush()
+    seq += 1
+for j in range(30):
+    v = 0.001 * (1 + ((7 * j + 3 * wid) % 40))
+    reg.observe("ensemble.e2e_s", v, tenant=tenant)
+    reg.inc("ensemble.steps_served", 1, tenant=tenant)
+    if j % 5 == 0:
+        reg.inc("ensemble.deadline_miss", 1, tenant=tenant)
+    if j % 3 == 0:
+        snap()
+    time.sleep(0.005)
+if wid == 1:
+    seq += 2  # injected seq gap: two line numbers never written
+snap()
+if wid == 1:
+    f.write('{"seq": %d, "ts"' % seq)  # torn final line: cut mid-write
+    f.flush()
+f.close()
+"""
+
+
+def _live_probe(g, adv, state, dt, steps: int, reps: int = 11,
+                threshold: float = 1.05,
+                skip_overhead: bool = False) -> list:
+    """Live-telemetry round (ISSUE 16).
+
+    Two subprocess writers stream deterministic registry snapshots into
+    a scratch directory (one injects a seq gap and a torn final line)
+    while the aggregator tails them; then the probe requires:
+
+    * windowed counts EXACT: the full-window fleet counters equal the
+      sum of both writers' final cumulative totals — tailing lost
+      nothing to the torn tail or the gap;
+    * the live windowed p99 equals the post-hoc pooled
+      ``obs/slo.py`` quantile on the same files to within one bucket
+      (the acceptance criterion: live == post-hoc on pooled exports);
+    * seq gaps and torn tails are COUNTED (tailer and
+      ``validate_stream`` agree on the tallies);
+    * a forced deadline-miss burst fires its alert rule EXACTLY once
+      (no flap across repeated polls) and leaves exactly one
+      schema-valid flight-recorder dump naming the rule;
+    * the <=5% overhead budget re-passes with a live tailer polling the
+      probe's own stream in the background (skipped with
+      ``--skip-overhead``)."""
+    import subprocess
+    import threading
+
+    from dccrg_tpu import obs
+    from dccrg_tpu.obs import alerts as alerts_mod
+    from dccrg_tpu.obs import flight_recorder, live, slo, validate_flightrec
+
+    failures: list = []
+    reg_path = str(ROOT / "dccrg_tpu" / "obs" / "registry.py")
+    prev_dir = flight_recorder.armed_dir
+    td = tempfile.mkdtemp(prefix="dccrg_live_probe_")
+    try:
+        paths = [os.path.join(td, f"writer{i}.stream.jsonl")
+                 for i in (0, 1)]
+        procs = [
+            subprocess.Popen([sys.executable, "-c", _LIVE_WRITER_SRC,
+                              reg_path, paths[i], str(i)])
+            for i in (0, 1)
+        ]
+        agg = live.FleetAggregator(td, window_s=3600.0)
+        while any(p.poll() is None for p in procs):
+            agg.poll()
+            time.sleep(0.02)
+        for i, p in enumerate(procs):
+            if p.returncode != 0:
+                failures.append(
+                    f"live probe: writer {i} exited {p.returncode}")
+        agg.poll()  # pick up the final lines (and the torn fragment)
+        view = agg.view()
+
+        # ---- exact windowed counts vs the writers' cumulative truth
+        served = view.counter("ensemble.steps_served")
+        missed = view.counter("ensemble.deadline_miss")
+        e2e = view.histogram("ensemble.e2e_s")
+        if served != 60:
+            failures.append(
+                f"live probe: windowed ensemble.steps_served {served} "
+                "!= 60 (2 writers x 30) — the tailer dropped lines")
+        if missed != 12:
+            failures.append(
+                f"live probe: windowed ensemble.deadline_miss {missed} "
+                "!= 12 (2 writers x 6)")
+        if int(e2e.get("count") or 0) != 60:
+            failures.append(
+                f"live probe: windowed e2e histogram count "
+                f"{e2e.get('count')} != 60")
+
+        # ---- live windowed p99 == post-hoc pooled within one bucket
+        pooled_reports = [slo.load_report(p) for p in paths]
+        pooled = slo.merge_series(pooled_reports, "ensemble.e2e_s")
+        pooled_all = slo.merge(*pooled.values()) if pooled else {}
+        for q in (0.5, 0.95, 0.99):
+            live_q = view.quantile("ensemble.e2e_s", q)
+            post_q = slo.quantile(pooled_all, q)
+            if live_q is None or post_q is None:
+                failures.append(
+                    f"live probe: q={q} unavailable "
+                    f"(live={live_q}, pooled={post_q})")
+                continue
+            bucket = 2.0 ** (1.0 / slo.SLO_RESOLUTION)
+            if not (post_q / bucket <= live_q <= post_q * bucket + 1e-12):
+                failures.append(
+                    f"live probe: windowed p{round(q * 100)} {live_q} "
+                    f"not within one bucket of pooled {post_q}")
+
+        # ---- anomaly counting: tailer and validate_stream agree
+        if view.health["seq_gaps"] != 2:
+            failures.append(
+                f"live probe: tailer counted {view.health['seq_gaps']} "
+                "seq gaps, expected exactly 2 (injected)")
+        if view.health["torn_tails"] < 1:
+            failures.append(
+                "live probe: the torn final line was never counted")
+        counts: dict = {}
+        vs_failures = validate_stream(paths[1], counts)
+        failures += [f"live probe writer1 stream: {f}"
+                     for f in vs_failures]
+        if counts.get("seq_gaps") != 2 or counts.get("torn_tail") != 1:
+            failures.append(
+                f"live probe: validate_stream counted {counts}, "
+                "expected seq_gaps=2 torn_tail=1")
+
+        # ---- forced deadline-miss burst: one fire, no flap, one dump
+        flight_recorder.arm(td, autodump=False)
+        rule = alerts_mod.AlertRule(
+            "burst-miss-rate", "ensemble.deadline_miss",
+            source="miss_rate", kind="ceiling",
+            threshold=0.01, clear=0.005, for_s=0.0)
+        engine = alerts_mod.AlertEngine(
+            [rule], registry=obs.metrics, flight_recorder=flight_recorder)
+        for _ in range(4):  # the burst persists: must not flap
+            engine.poll(view)
+        st = engine.state("burst-miss-rate")
+        if st["fires"] != 1 or st["clears"] != 0 \
+                or st["status"] != "firing":
+            failures.append(
+                f"live probe: alert fired {st['fires']}x cleared "
+                f"{st['clears']}x status={st['status']} — wanted "
+                "exactly one fire, still firing (no flap)")
+        dumps = sorted(
+            p for p in os.listdir(td)
+            if p.startswith("flightrec_") and p.endswith(".json"))
+        if len(dumps) != 1:
+            failures.append(
+                f"live probe: alert firing left {len(dumps)} dumps "
+                f"({dumps}), wanted exactly one per incident")
+        for p in dumps:
+            full = os.path.join(td, p)
+            failures += [f"live probe flightrec {p}: {f}"
+                         for f in validate_flightrec(full)]
+            with open(full) as fh:
+                rec = json.load(fh)
+            named = "burst-miss-rate" in str(rec.get("reason", "")) or any(
+                ev.get("rule") == "burst-miss-rate"
+                for ev in rec.get("events", [])
+                if isinstance(ev, dict))
+            if not named:
+                failures.append(
+                    f"live probe: postmortem {p} does not name the "
+                    "firing rule")
+
+        # ---- overhead budget re-passed with a live tailer running
+        if not skip_overhead:
+            stream_path = os.path.join(td, "probe.stream.jsonl")
+            s = obs.TelemetryStream(stream_path, period=0.05,
+                                    truncate=True)
+            s.start()
+            tail_agg = live.FleetAggregator([stream_path],
+                                            window_s=60.0)
+            stop_evt = threading.Event()
+
+            def _tail_loop():
+                while not stop_evt.is_set():
+                    tail_agg.poll()
+                    stop_evt.wait(0.05)
+
+            t = threading.Thread(target=_tail_loop, daemon=True)
+            t.start()
+            try:
+                over = _overhead_probe(g, adv, state, dt, steps,
+                                       reps=reps, threshold=threshold)
+                failures += [f"with live tailer: {f}" for f in over]
+            finally:
+                stop_evt.set()
+                t.join(timeout=5.0)
+                s.stop(final=False)
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"live probe failed: {e!r}")
+    finally:
+        if prev_dir is not None:
+            flight_recorder.arm(prev_dir)
+        else:
+            flight_recorder.disarm()
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+    return failures
+
+
 def _device_timeline_probe(g, adv, state, dt, out_path: str,
                            merged_path: str | None = None) -> list:
     """Profiled round (ISSUE 6): capture one split-phase drive under
@@ -1270,6 +1516,9 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
         # land inside the timed reps and flake the 5% budget
         failures += _overhead_probe(g, adv, state, dt, steps,
                                     reps=reps, threshold=threshold)
+    failures += _live_probe(g, adv, state, dt, steps,
+                            reps=reps, threshold=threshold,
+                            skip_overhead=skip_overhead)
     failures += _elastic_probe(g, state)
     failures += _device_timeline_probe(
         g, adv, state, dt, out_path,
@@ -1407,8 +1656,14 @@ def main(argv=None) -> int:
             args.validate_merged_trace:
         failures = []
         if args.validate_stream:
+            counts: dict = {}
             failures += [f"stream: {f}"
-                         for f in validate_stream(args.validate_stream)]
+                         for f in validate_stream(args.validate_stream,
+                                                  counts)]
+            print(f"stream: {counts['lines']} lines, "
+                  f"{counts['seq_gaps']} seq gaps, "
+                  f"{counts['torn_tail']} torn tail, "
+                  f"{counts['bad_lines']} bad lines", file=sys.stderr)
         if args.validate_trace:
             failures += [f"trace: {f}"
                          for f in validate_chrome_trace(args.validate_trace)]

@@ -90,14 +90,17 @@ DTYPE_SENSITIVE = {"sum", "prod", "cumsum", "cumprod", "arange"}
 #: stdlib-only by contract — report/diff tools must file-load without
 #: jax — except the listed exemptions, which are jax benchmarks.
 STDLIB_ONLY_EXTRA = ("dccrg_tpu/obs/slo.py", "dccrg_tpu/obs/flightrec.py",
-                     "dccrg_tpu/obs/registry.py")
+                     "dccrg_tpu/obs/registry.py", "dccrg_tpu/obs/live.py",
+                     "dccrg_tpu/obs/alerts.py")
 STDLIB_ONLY_TOOL_EXEMPT = {"flat_kernel_bench.py"}
 
 #: subprocess import-probe targets: file-load must leave sys.modules
 #: jax-free (flightrec/registry are package-relative, probed via slo's
 #: loader contract instead — see tests/test_lint.py)
-PROBE_TARGETS = ("dccrg_tpu/obs/slo.py", "tools/slo_report.py",
-                 "tools/telemetry_diff.py", "tools/dccrg_lint.py")
+PROBE_TARGETS = ("dccrg_tpu/obs/slo.py", "dccrg_tpu/obs/live.py",
+                 "dccrg_tpu/obs/alerts.py", "tools/slo_report.py",
+                 "tools/fleet_top.py", "tools/telemetry_diff.py",
+                 "tools/dccrg_lint.py")
 
 #: HOST-SYNC hot paths: per file, the function qualnames that sit on
 #: the steady-state dispatch path.  The check is lexical (this body

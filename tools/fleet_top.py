@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Live fleet console: windowed SLOs, throughput and health from the
+per-process ``*.stream.jsonl`` files a running fleet writes.
+
+Point it at the directory the serving processes stream into (or a glob,
+or explicit files) and it tails every stream from byte offsets, merges
+counters and log-bucket histograms across processes (exact: merging
+per-process exports equals pooling the samples), and prints one
+windowed snapshot — or refreshes in place with ``--follow``::
+
+    python tools/fleet_top.py /var/run/dccrg/          # one snapshot
+    python tools/fleet_top.py run/ --window 30 --follow
+    python tools/fleet_top.py run/ --json -            # machine-readable
+    python tools/fleet_top.py run/ --prometheus fleet.prom
+    python tools/fleet_top.py run/ --alerts            # rule states too
+
+This tool file-loads ``dccrg_tpu/obs/live.py`` (stdlib-only by
+contract), so watching a fleet never imports jax.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import pathlib
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: latency histograms tabulated per window (--metrics overrides)
+DEFAULT_METRICS = (
+    "ensemble.queue_wait_s",
+    "ensemble.service_s",
+    "ensemble.e2e_s",
+)
+
+#: windowed counter rates shown in the throughput block
+RATE_COUNTERS = (
+    "ensemble.steps_served",
+    "ensemble.retired",
+    "ensemble.deadline_miss",
+)
+
+
+def _load(name: str):
+    path = ROOT / "dccrg_tpu" / "obs" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(
+        f"dccrg_fleet_{name}", str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def snapshot(view, metrics, qs) -> dict:
+    """One JSON-ready fleet snapshot from a view."""
+    latency = []
+    for name in metrics:
+        series = (view.window_report.get("histograms") or {}).get(name) or {}
+        for label in sorted(series):
+            h = series[label]
+            row = {"metric": name, "labels": label,
+                   "count": int(h.get("count") or 0),
+                   "mean": h.get("mean")}
+            for q in qs:
+                row[f"p{round(q * 100):d}"] = view.quantile(
+                    name, q, labels=_labels_dict(label))
+            latency.append(row)
+    rates = {}
+    for name in RATE_COUNTERS:
+        series = (view.window_report.get("counters") or {}).get(name) or {}
+        if series:
+            rates[name] = {label: v / view.window_s
+                           for label, v in sorted(series.items())}
+    return {
+        "ts": view.now,
+        "window_s": view.window_s,
+        "health": view.health,
+        "files": view.files,
+        "latency": latency,
+        "rates": rates,
+        "deadline_miss_rates": view.miss_rates(),
+        "gauges": view.cumulative_report.get("gauges") or {},
+    }
+
+
+def _labels_dict(label_str: str) -> dict:
+    return dict(kv.split("=", 1)
+                for kv in (label_str or "").split(",") if "=" in kv)
+
+
+def print_snapshot(snap: dict, qs, alerts=None) -> None:
+    h = snap["health"]
+    print(f"fleet_top  window={snap['window_s']:.0f}s  "
+          f"files={h['files']} ({h['stale_files']} stale)  "
+          f"records={h['records']}  seq_gaps={h['seq_gaps']}  "
+          f"torn_tails={h['torn_tails']}  bad_lines={h['bad_lines']}")
+    qcols = [f"p{round(q * 100):d}" for q in qs]
+    if snap["latency"]:
+        head = (f"{'metric':24s} {'labels':28s} {'count':>7s} "
+                + " ".join(f"{c + '(ms)':>10s}" for c in ["mean"] + qcols))
+        print(head)
+        print("-" * len(head))
+        for r in snap["latency"]:
+            cells = [r.get("mean")] + [r.get(c) for c in qcols]
+            print(f"{r['metric']:24s} {r['labels']:28s} {r['count']:>7d} "
+                  + " ".join("       n/a" if v is None
+                             else f"{v * 1e3:>10.3f}" for v in cells))
+    else:
+        print("  (no latency samples in the window)")
+    if snap["rates"]:
+        print()
+        print(f"{'counter':28s} {'labels':24s} {'rate/s':>10s}")
+        for name, series in sorted(snap["rates"].items()):
+            for label, r in series.items():
+                print(f"{name:28s} {label:24s} {r:>10.3f}")
+    miss = snap["deadline_miss_rates"]
+    if miss:
+        print()
+        print(f"{'tenant':16s} {'completed':>9s} {'missed':>7s} {'rate':>8s}")
+        for tenant, rec in sorted(miss.items()):
+            rate = rec["rate"]
+            print(f"{tenant:16s} {rec['completed']:>9d} "
+                  f"{rec['missed']:>7d} "
+                  f"{'n/a' if rate is None else f'{rate:8.2%}'}")
+    if alerts is not None:
+        print()
+        print(f"{'alert rule':28s} {'status':8s} {'value':>12s} "
+              f"{'fires':>6s}")
+        for name, st in sorted(alerts.items()):
+            v = st.get("value")
+            print(f"{name:28s} {st['status']:8s} "
+                  f"{'n/a' if v is None else f'{v:12.4g}'} "
+                  f"{st['fires']:>6d}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("sources", nargs="*", default=["."],
+                    help="stream dir(s), glob(s) or *.stream.jsonl files")
+    ap.add_argument("--window", type=float, default=None,
+                    help="sliding window seconds "
+                         "(default DCCRG_LIVE_WINDOW_S or 60)")
+    ap.add_argument("--metrics", default=",".join(DEFAULT_METRICS),
+                    help="comma-separated histogram names to tabulate")
+    ap.add_argument("--quantiles", default="0.5,0.95,0.99",
+                    help="comma-separated quantile fractions")
+    ap.add_argument("--json", default=None,
+                    help="write the snapshot JSON to this path ('-' "
+                         "for stdout, replacing the console view)")
+    ap.add_argument("--prometheus", default=None,
+                    help="write a Prometheus text exposition of the "
+                         "windowed report to this path ('-' for stdout)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="evaluate the alert rules (DCCRG_ALERT_RULES "
+                         "or the shipped defaults) against each view")
+    ap.add_argument("--follow", action="store_true",
+                    help="refresh in place every --refresh seconds")
+    ap.add_argument("--refresh", type=float, default=2.0,
+                    help="refresh period for --follow")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="with --follow: stop after N refreshes "
+                         "(0 = until interrupted)")
+    args = ap.parse_args(argv)
+
+    live = _load("live")
+    qs = tuple(float(x) for x in args.quantiles.split(",") if x)
+    metrics = [m for m in args.metrics.split(",") if m]
+    paths: list = []
+    for src in args.sources:
+        paths.extend(live.discover_streams(src))
+    if not paths and not args.follow:
+        print("fleet_top: no *.stream.jsonl sources found",
+              file=sys.stderr)
+        return 2
+    # a single directory source keeps discovering new writers per poll
+    sources = (args.sources[0]
+               if len(args.sources) == 1 and not paths else paths)
+    agg = live.FleetAggregator(sources, window_s=args.window)
+    engine = None
+    if args.alerts:
+        alerts_mod = _load("alerts")
+        if alerts_mod.alerts_enabled():
+            engine = alerts_mod.AlertEngine(alerts_mod.rules_from_env())
+
+    n = 0
+    while True:
+        agg.poll()
+        view = agg.view()
+        alert_states = None
+        if engine is not None:
+            engine.poll(view)
+            alert_states = engine.snapshot()
+        snap = snapshot(view, metrics, qs)
+        if alert_states is not None:
+            snap["alerts"] = alert_states
+        if args.prometheus:
+            text = live.to_prometheus(view.window_report)
+            if args.prometheus == "-":
+                sys.stdout.write(text)
+            else:
+                with open(args.prometheus, "w") as f:
+                    f.write(text)
+        if args.json:
+            text = json.dumps(snap, indent=1, default=float)
+            if args.json == "-":
+                print(text)
+            else:
+                with open(args.json, "w") as f:
+                    f.write(text)
+        elif not (args.prometheus == "-"):
+            if args.follow and n:
+                print()
+            print_snapshot(snap, qs, alerts=alert_states)
+        n += 1
+        if not args.follow or (args.iterations and n >= args.iterations):
+            break
+        try:
+            time.sleep(max(args.refresh, 0.1))
+        except KeyboardInterrupt:
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,16 @@ def load_slo():
     return mod
 
 
+def load_live():
+    """The live aggregator (``obs/live.py``), file-loaded under the
+    same stdlib-only contract — ``--live`` never imports jax either."""
+    path = ROOT / "dccrg_tpu" / "obs" / "live.py"
+    spec = importlib.util.spec_from_file_location("dccrg_live", str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def combine_reports(slo, reports: list, metrics) -> dict:
     """One merged pseudo-report: histograms merged per (name, label),
     counters summed per (name, label) — each input report is one
@@ -197,6 +207,57 @@ def print_drilldown(slow: list) -> None:
             print("    (no overlapping spans from other tracks)")
 
 
+def live_report(slo, args, metrics, qs) -> int:
+    """``--live``: windowed per-tenant tables from stream dirs via the
+    aggregator; ``--follow`` re-polls and reprints every refresh."""
+    import time
+
+    live = load_live()
+    agg = live.FleetAggregator(args.live, window_s=args.window)
+    rounds = 0
+    while True:
+        agg.poll()
+        view = agg.view()
+        combined = {
+            "histograms": {
+                name: series for name, series in
+                (view.window_report.get("histograms") or {}).items()
+                if name in metrics
+            },
+            "counters": view.window_report.get("counters") or {},
+        }
+        if rounds:
+            print()
+        h = view.health
+        print(f"live window={view.window_s:.0f}s  files={h['files']} "
+              f"({h['stale_files']} stale)  records={h['records']}  "
+              f"seq_gaps={h['seq_gaps']}  torn_tails={h['torn_tails']}")
+        rows = quantile_table(slo, combined, qs)
+        miss_rates = slo.deadline_miss_rates(combined)
+        print_tables(rows, miss_rates, qs)
+        if args.json:
+            report = {
+                "live": args.live,
+                "window_s": view.window_s,
+                "health": h,
+                "quantiles": list(qs),
+                "latency": rows,
+                "deadline_miss_rates": miss_rates,
+            }
+            tmp = args.json + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1, default=float)
+            os.replace(tmp, args.json)
+        rounds += 1
+        if not args.follow:
+            break
+        try:
+            time.sleep(max(args.refresh, 0.1))
+        except KeyboardInterrupt:
+            break
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -217,11 +278,27 @@ def main(argv=None) -> int:
                     help="slowest requests to drill into")
     ap.add_argument("--json", default=None,
                     help="also write the full report object to this path")
+    ap.add_argument("--live", default=None, metavar="DIR",
+                    help="tail *.stream.jsonl files under DIR via the "
+                         "live aggregator and report the WINDOWED "
+                         "per-tenant view instead of final exports")
+    ap.add_argument("--window", type=float, default=None,
+                    help="with --live: sliding window seconds "
+                         "(default DCCRG_LIVE_WINDOW_S or 60)")
+    ap.add_argument("--follow", action="store_true",
+                    help="with --live: refresh the tables every "
+                         "--refresh seconds until interrupted")
+    ap.add_argument("--refresh", type=float, default=2.0,
+                    help="refresh period for --follow")
     args = ap.parse_args(argv)
 
     slo = load_slo()
     qs = tuple(float(x) for x in args.quantiles.split(",") if x)
     metrics = [m for m in args.metrics.split(",") if m]
+
+    if args.live:
+        return live_report(slo, args, metrics, qs)
+
     reports = []
     for src in args.sources:
         try:

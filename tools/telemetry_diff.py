@@ -73,6 +73,15 @@ GATED_COUNTERS = (
     "epoch.recompiles",
 )
 
+#: counters REPORTED round-over-round but never failed (ISSUE 16): how
+#: many alert rules fired is incident evidence the diff should surface
+#: next to the perf verdict, but firing count is workload-shaped (a
+#: fault-injection round SHOULD fire) — a rise is information, not a
+#: regression
+INFO_COUNTERS = (
+    "alerts.fired",
+)
+
 
 def load_counters(path: str) -> dict | None:
     """Counter table ``{name: {labels: value}}`` from the same shapes
@@ -106,24 +115,37 @@ def load_counters(path: str) -> dict | None:
 
 def compare_counters(current: dict | None, baseline: dict | None,
                      threshold: float = 0.35,
-                     counters=GATED_COUNTERS) -> dict:
+                     counters=GATED_COUNTERS,
+                     informational=()) -> dict:
     """Round-over-round gate on counter TOTALS (labels summed).  Either
     side missing the table (old rounds, bench records without counters)
     passes vacuously — the gate only engages once both rounds carry
-    counter evidence."""
+    counter evidence.  ``informational`` counters are tabulated the same
+    way but can never fail the gate (status ``info``)."""
     rows = []
     failures = []
     if current is None or baseline is None:
         return {"verdict": "PASS", "rows": rows, "failures": failures}
-    for name in counters:
+    info = set(informational)
+    for name in tuple(counters) + tuple(informational):
         b = baseline.get(name)
         c = current.get(name)
         if b is None:
+            if name in info and c:
+                # informational counters surface even without baseline
+                # history — new alert activity is evidence, not a fail
+                rows.append({"counter": name, "base_total": 0,
+                             "cur_total": sum(c.values()),
+                             "status": "info"})
             continue
         b_tot = sum(b.values())
         c_tot = sum(c.values()) if c else 0
         row = {"counter": name, "base_total": b_tot, "cur_total": c_tot}
-        if b_tot > 0:
+        if name in info:
+            row["status"] = "info"
+            if b_tot > 0:
+                row["ratio"] = round(c_tot / b_tot, 3)
+        elif b_tot > 0:
             ratio = c_tot / b_tot
             row["ratio"] = round(ratio, 3)
             if ratio > 1.0 + threshold:
@@ -182,6 +204,12 @@ DEFAULT_ALLOW = (
     # gate DOES watch is the request-latency quantile ceiling
     # (GATED_QUANTILES below).
     "flightrec.dump",
+    # ISSUE 16 live-telemetry phases: an aggregator poll is sized by how
+    # many stream files grew and by how much, an alert evaluation by how
+    # many rules the run configured — both workload-shaped.  The alert
+    # OUTCOME is surfaced via the informational alerts.fired counter.
+    "live.poll",
+    "alerts.evaluate",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
@@ -676,7 +704,7 @@ def main(argv=None) -> int:
     # counter tables
     cgate = compare_counters(
         load_counters(args.current), load_counters(baseline_path),
-        threshold=args.threshold,
+        threshold=args.threshold, informational=INFO_COUNTERS,
     )
     verdict["counter_gate"] = cgate
     if cgate["verdict"] == "FAIL":
