@@ -776,6 +776,24 @@ def measure_wide_halo() -> dict:
     return out
 
 
+def measure_cost_model() -> dict:
+    """ISSUE 17 on-chip target: the cost-model-armed vs EMA-only
+    deadline burst — a mixed tight/generous deadline wave under the
+    deadline scheduling policy, once with the online step-cost model
+    pricing ``select_k`` slack and once with ``DCCRG_COST_MODEL=0``
+    (EMA fallback).  The acceptance bar is miss_delta ≤ 0: informed
+    depth pricing must never miss more deadlines than the EMA it
+    replaces."""
+    import jax
+
+    from benchmarks.microbench import cost_summary
+
+    out = cost_summary()
+    out["device_kind"] = jax.devices()[0].device_kind
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def measure_multidev_cpu() -> dict | None:
     """8-device virtual CPU mesh (subprocess): plumbing/correctness
     evidence (device-count-invariant checksum) plus the split-phase
@@ -1290,6 +1308,42 @@ def _attach_ensemble(record: dict) -> None:
         print(f"ensemble probe failed: {e}", file=sys.stderr)
 
 
+def _attach_cost(record: dict) -> None:
+    """Fold the cost-plane burst comparison (ISSUE 17) into the record
+    under ``detail.telemetry.cost``: deadline misses with the step-cost
+    model pricing ``select_k`` vs the EMA-only fallback on the same
+    mixed-deadline wave, plus the armed arm's predict level/n.  Run in
+    a child on the virtual CPU mesh so an accelerator outage never
+    blocks the bench line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from benchmarks.microbench import cost_summary; "
+        "print(json.dumps(cost_summary()))"
+        % str(ROOT)
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        if r.returncode != 0:
+            print(f"cost probe failed: {r.stderr[-300:]}",
+                  file=sys.stderr)
+            return
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        record.setdefault("detail", {}).setdefault(
+            "telemetry", {})["cost"] = json.loads(line)
+    except Exception as e:  # noqa: BLE001 - never kills the bench
+        print(f"cost probe failed: {e}", file=sys.stderr)
+
+
 def _slo_summary(report: dict) -> dict:
     """Latency quantiles + deadline-miss rates out of one exported
     telemetry report (ISSUE 10), via the stdlib-only ``obs/slo.py``
@@ -1429,6 +1483,7 @@ def _emit(record: dict):
     _attach_halo_overlap(record)
     _attach_elastic(record)
     _attach_ensemble(record)
+    _attach_cost(record)
     try:
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(record, indent=1))
     except OSError as e:

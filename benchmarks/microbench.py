@@ -904,6 +904,138 @@ def bench_ensemble(length: int = 4, steps: int = 16):
     }))
 
 
+def cost_summary(length: int = 4, steps: int = 16, B: int = 8,
+                 k: int = 4, seed: int = 0) -> dict:
+    """Model-priced vs EMA-only scheduling (ISSUE 17): the same
+    deadline-mixed burst served twice — once with the fleet cost model
+    pricing ``select_k``'s slack clamp (``DCCRG_COST_MODEL=1``, the
+    default) and once on the pre-cost cohort-local EMA path
+    (``DCCRG_COST_MODEL=0``) — importable so ``bench.py`` folds it into
+    ``detail.telemetry.cost``.  The switch is read per call, so the two
+    arms flip mid-process with no respawn.
+
+    Per arm: a warm wave compiles the depth-k body (and, armed, trains
+    the exact ``(model, sig, k, g, W)`` key past
+    ``DCCRG_COST_MIN_SAMPLES``), a solo pace round measures per-step
+    seconds, then a burst of ``B`` scenarios — half with deadlines
+    affording roughly half their steps at the measured pace, half
+    generous — runs under the deadline policy.  Reported per arm:
+    deadline misses / miss rate, scenarios·steps/sec per chip, and the
+    answering prediction's level and sample count.  The acceptance
+    direction: the armed arm must not miss MORE than EMA-only."""
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh
+    from dccrg_tpu.models import GameOfLife
+    from dccrg_tpu.obs import cost
+    from dccrg_tpu.serve import Scenario, Scheduler
+
+    g = (
+        Grid()
+        .set_initial_length((length, length, length))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(
+            CartesianGeometry,
+            start=(0.0, 0.0, 0.0),
+            level_0_cell_length=(1.0 / length,) * 3,
+        )
+        .initialize(mesh=make_mesh())
+    )
+    g.stop_refining()
+    gol = GameOfLife(g, allow_dense=False)
+    cells = g.get_cells()
+    rng = np.random.default_rng(seed)
+
+    def fresh_state():
+        return gol.new_state(
+            alive_cells=cells[rng.random(len(cells)) < 0.3]
+        )
+
+    chips = max(g.n_devices, 1)
+
+    def run_arm(armed: bool) -> dict:
+        os.environ["DCCRG_COST_MODEL"] = "1" if armed else "0"
+        cost.model.reset()
+        cost.tracker.reset()
+        # warm both dispatch depths the burst can reach at the burst's
+        # width: the configured k AND the depth-1 body a blown deadline
+        # clamps to — otherwise the first arm pays that compile inside
+        # its timed window and the arms stop being comparable
+        for depth in (k, 1):
+            warm = Scheduler(steps_per_dispatch=depth)
+            for _ in range(max(cost.min_samples(), 4)):
+                warm.submit(Scenario(gol, fresh_state(),
+                                     steps if depth == k else 2,
+                                     tenant="warm"))
+            warm.run()
+        # throwaway solo round first: the width-1 body compiles here in
+        # whichever arm runs first, so both arms measure a warm pace
+        for timed in (False, True):
+            pace_sched = Scheduler(steps_per_dispatch=k)
+            pace_sched.submit(Scenario(gol, fresh_state(), steps,
+                                       tenant="pace"))
+            t0 = time.perf_counter()
+            pace_sched.run()
+            if timed:
+                pace = (time.perf_counter() - t0) / steps
+        m0 = _counter_total("ensemble.deadline_miss")
+        sched = Scheduler(policy="deadline", steps_per_dispatch=k)
+        now = time.perf_counter()
+        for i in range(B):
+            tight = i % 2 == 0
+            sched.submit(Scenario(
+                gol, fresh_state(), steps, tenant=f"c{i % 2}",
+                deadline=now + steps * pace * (0.5 if tight else 50.0),
+            ))
+        t0 = time.perf_counter()
+        sched.run()
+        elapsed = time.perf_counter() - t0
+        misses = _counter_total("ensemble.deadline_miss") - m0
+        est = cost.model.predict("gol") if armed else None
+        return {
+            "deadline_misses": int(misses),
+            "miss_rate": round(misses / B, 3),
+            "scenarios_steps_per_s_per_chip": round(
+                B * steps / max(elapsed, 1e-12) / chips, 1),
+            "elapsed_s": round(elapsed, 6),
+            "pace_step_s": round(pace, 6),
+            "predict_level": est.level if est is not None else None,
+            "predict_n": est.n if est is not None else 0,
+        }
+
+    prev = os.environ.get("DCCRG_COST_MODEL")
+    try:
+        out = {
+            "model": "gol",
+            "n_devices": g.n_devices,
+            "B": B, "k": int(k), "steps": steps,
+            "armed": run_arm(True),
+            "ema_only": run_arm(False),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("DCCRG_COST_MODEL", None)
+        else:
+            os.environ["DCCRG_COST_MODEL"] = prev
+    out["miss_delta_armed_minus_ema"] = (
+        out["armed"]["deadline_misses"]
+        - out["ema_only"]["deadline_misses"])
+    return out
+
+
+def bench_cost(length: int = 4, steps: int = 16):
+    """Print the :func:`cost_summary` comparison as a bench metric:
+    value = deadline misses with the cost model armed (the unit string
+    carries the EMA-only count — the acceptance is armed <= EMA)."""
+    s = cost_summary(length=length, steps=steps)
+    print(json.dumps({
+        "metric": "cost_model_deadline_misses",
+        "value": s["armed"]["deadline_misses"],
+        "unit": (f"misses of {s['B']} (EMA-only "
+                 f"{s['ema_only']['deadline_misses']}, k={s['k']})"),
+        "detail": s,
+    }))
+
+
 def halo_overlap_summary(steps: int = 20, length: int = 8, reps: int = 3,
                          seed: int = 0, profile: bool = True) -> dict:
     """Eager vs host-split vs fused split-phase stepping per model
@@ -1177,6 +1309,7 @@ def main():
     bench_halo_overlap()
     bench_ensemble()
     bench_wide_halo()
+    bench_cost()
     bench_particles(args.particles)
 
 

@@ -933,14 +933,20 @@ class Advection:
             check_vma=False,
         )
 
+        # z-face masks as runtime-argument tables (ROADMAP item 4): the
+        # jitted bodies are table-content-independent; only the plain
+        # wrappers below close over the device copies
         @jax.jit
-        def step(state, dt):
+        def step_fn(zf_up, zf_dn, state, dt):
             (new_rho,) = fn(
-                zf_up_dev, zf_dn_dev,
+                zf_up, zf_dn,
                 state["density"], state["vx"], state["vy"], state["vz"],
                 jnp.asarray(dt, dtype),
             )
             return {**state, "density": new_rho}
+
+        def step(state, dt):
+            return step_fn(zf_up_dev, zf_dn_dev, state, dt)
 
 
         # Whole-block multi-step kernel (single device, block fits VMEM):
@@ -995,15 +1001,16 @@ class Advection:
             )
 
             @jax.jit
-            def dense_run_fn(state, steps, dt):
+            def dense_run_fn(zf_up, zf_dn, state, steps, dt):
                 (new_rho,) = run_sm(
-                    zf_up_dev, zf_dn_dev,
+                    zf_up, zf_dn,
                     state["density"], state["vx"], state["vy"], state["vz"],
                     jnp.asarray(dt, dtype), jnp.asarray(steps, jnp.int32),
                 )
                 return {**state, "density": new_rho}
 
-            dense_run = dense_run_fn
+            def dense_run(state, steps, dt):
+                return dense_run_fn(zf_up_dev, zf_dn_dev, state, steps, dt)
 
         dx = self._dx
 
@@ -1055,12 +1062,15 @@ class Advection:
         )
 
         @jax.jit
-        def dense_max_diff(state, diff_threshold):
+        def max_diff_fn(zf_up, zf_dn, state, diff_threshold):
             (md,) = fn_md(
-                zf_up_dev, zf_dn_dev, state["density"],
+                zf_up, zf_dn, state["density"],
                 jnp.asarray(diff_threshold, dtype),
             )
             return {**state, "max_diff": md}
+
+        def dense_max_diff(state, diff_threshold):
+            return max_diff_fn(zf_up_dev, zf_dn_dev, state, diff_threshold)
 
         return {
             "step": step,

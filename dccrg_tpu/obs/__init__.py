@@ -68,6 +68,19 @@ seam instead:
   ``DCCRG_ALERT_RULES``, ``DCCRG_STREAM_FLUSH_S``;
   ``tools/fleet_top.py`` and ``slo_report.py --live`` are the consoles).
 
+* the PREDICTIVE side (ISSUE 17): ``obs.cost`` turns recorded
+  telemetry into forecasts — an online :class:`~dccrg_tpu.obs.cost.
+  StepCostModel` of per-step dispatch cost keyed by
+  ``(model, sig, k, g, W)`` with a documented cold-start fallback
+  chain (exact → same-model → global), a per-tenant chargeback ledger
+  (device-seconds, member-steps, halo exchanges, compile time
+  attributed from existing series under a conservation invariant) and
+  predicted queue-wait gauges (``cost.predicted_queue_wait_s{tenant}``)
+  that ``Scheduler.select_k`` and admission advice consume
+  (``DCCRG_COST_MODEL``, ``DCCRG_COST_MIN_SAMPLES``,
+  ``DCCRG_COST_QUANTILE``; ``tools/cost_report.py`` and
+  ``fleet_top.py --cost`` are the consoles).
+
 Telemetry is on by default (the recording sites are per-epoch or
 per-host-dispatch, never inside device loops); ``disable()`` — or
 ``DCCRG_TELEMETRY=0`` in the environment — makes every recording call a
@@ -91,6 +104,7 @@ from . import fused
 from . import slo
 from . import live
 from . import alerts
+from . import cost
 from . import xplane
 from .flightrec import (
     FlightRecorder,
@@ -129,6 +143,7 @@ __all__ = [
     "slo",
     "live",
     "alerts",
+    "cost",
     "xplane",
     "FlightRecorder",
     "flight_recorder",

@@ -439,6 +439,14 @@ class FleetAggregator:
             age = now - latest_ts
             if age > window:
                 health["stale_files"] += 1
+            # per-writer staleness gauge (ISSUE 17 satellite): a dead
+            # writer otherwise just freezes its numbers into every
+            # window — this makes the silence itself a series the
+            # consoles and alert rules can watch
+            reg = self._registry
+            if reg is not None and getattr(reg, "enabled", False):
+                reg.gauge("stream.age_s", age,
+                          path=pathlib.Path(path).name)
             per_file_window.append(_sub_report(latest, edge))
             per_file_cum.append(latest)
             files.append({"path": path, "last_ts": latest_ts, "age_s": age,

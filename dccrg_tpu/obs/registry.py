@@ -317,37 +317,50 @@ class MetricsRegistry:
                 out.setdefault(name, {})[_labels_str(lk)] = v
             return {n: dict(sorted(s.items())) for n, s in sorted(out.items())}
 
+        # hold the lock only for shallow copies of the raw stores —
+        # sorting, label formatting and bucket stringification happen
+        # outside, so a periodic stream snapshot (every ~50ms under a
+        # live writer) never stalls the hot-path inc/observe callers
+        # contending for the same lock
         with self._lock:
-            phases = {
-                name: {
-                    "total_s": round(t, 6),
-                    "count": c,
-                    "mean_s": round(t / max(c, 1), 6),
-                }
-                for name, (t, c) in sorted(self._phases.items())
+            phases_raw = dict(self._phases)
+            counters_raw = dict(self._counters)
+            gauges_raw = dict(self._gauges)
+            hists_raw = {
+                key: (cnt, tot, mn, mx, dict(buckets))
+                for key, (cnt, tot, mn, mx, buckets)
+                in self._hists.items()
             }
-            counters = grouped(self._counters)
-            gauges = grouped(self._gauges)
-            hists = {}
-            for (name, lk), (cnt, tot, mn, mx, buckets) in sorted(
-                self._hists.items()
-            ):
-                hists.setdefault(name, {})[_labels_str(lk)] = {
-                    "count": cnt,
-                    "sum": tot,
-                    "mean": tot / max(cnt, 1),
-                    "min": mn,
-                    "max": mx,
-                    "buckets": {
-                        "0" if e is None else str(2.0 ** e): n
-                        for e, n in sorted(
-                            buckets.items(),
-                            key=lambda kv: (
-                                -math.inf if kv[0] is None else kv[0]
-                            ),
-                        )
-                    },
-                }
+        phases = {
+            name: {
+                "total_s": round(t, 6),
+                "count": c,
+                "mean_s": round(t / max(c, 1), 6),
+            }
+            for name, (t, c) in sorted(phases_raw.items())
+        }
+        counters = grouped(counters_raw)
+        gauges = grouped(gauges_raw)
+        hists = {}
+        for (name, lk), (cnt, tot, mn, mx, buckets) in sorted(
+            hists_raw.items()
+        ):
+            hists.setdefault(name, {})[_labels_str(lk)] = {
+                "count": cnt,
+                "sum": tot,
+                "mean": tot / max(cnt, 1),
+                "min": mn,
+                "max": mx,
+                "buckets": {
+                    "0" if e is None else str(2.0 ** e): n
+                    for e, n in sorted(
+                        buckets.items(),
+                        key=lambda kv: (
+                            -math.inf if kv[0] is None else kv[0]
+                        ),
+                    )
+                },
+            }
         return {
             "phases": phases,
             "counters": counters,

@@ -150,6 +150,7 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import cost as obs_cost
 from ..obs import stream as obs_stream
 from ..obs.events import timeline
 from ..obs.flightrec import recorder as flightrec
@@ -839,13 +840,25 @@ class Cohort:
         per_step = dt_wall / k
         self.step_s_ema = (per_step if self.step_s_ema is None
                            else 0.5 * self.step_s_ema + 0.5 * per_step)
+        cost_on = obs_cost.enabled()
+        if cost_on:
+            # online step-cost model (ISSUE 17): one per-interior-step
+            # sample under the full compiled-body key — every dimension
+            # that selects a distinct executable prices separately
+            obs_cost.record_dispatch(self.spec.kind, self.sig_label,
+                                     k, g, self.W, dt_wall)
+        served: dict = {}
+        for slot in np.flatnonzero(mask):
+            scn = self.members[slot]
+            adv = int(advanced[slot])
+            scn.steps_done += adv
+            served[scn.tenant] = served.get(scn.tenant, 0) + adv
+        # per-tenant member-steps this dispatch advanced — the scheduler
+        # reads this to feed the capacity tracker per scheduling TICK
+        # (dispatch + admission + retirement overhead), because a queued
+        # backlog drains at the tick rate, not the bare kernel rate
+        self._served_last = served
         if metrics.enabled:
-            served: dict = {}
-            for slot in np.flatnonzero(mask):
-                scn = self.members[slot]
-                adv = int(advanced[slot])
-                scn.steps_done += adv
-                served[scn.tenant] = served.get(scn.tenant, 0) + adv
             metrics.inc_many([
                 ("ensemble.steps_served", v, {"tenant": t})
                 for t, v in served.items()
@@ -862,12 +875,12 @@ class Cohort:
                      {"tenant": t, "model": self.spec.kind})
                     for t, v in served.items()
                 ])
+                # the chargeback conservation companion: the unlabeled
+                # wall×mesh total the per-tenant splits must sum to
+                metrics.inc("ensemble.device_s_total", device_total)
             metrics.gauge("ensemble.steps_per_dispatch", k,
                           model=self.spec.kind)
             self._sample_hbm()
-        else:
-            for slot in np.flatnonzero(mask):
-                self.members[slot].steps_done += int(advanced[slot])
         if verify_slot is not None:
             self._verify(pre_member, verify_slot,
                          int(advanced[verify_slot]))
@@ -983,6 +996,14 @@ class Scheduler:
         #: held width per cohort key (the hysteresis hints of the
         #: width ladder — survive cohort teardown like grid ring hints)
         self._width_hints: dict = {}
+        #: tenants that ever had a gauged backlog: drained tenants get
+        #: one more zero write so stale gauges never freeze into live
+        #: windows (ISSUE 17)
+        self._gauged_tenants: set = set()
+        #: admission wall-seconds not yet charged to a scheduling tick —
+        #: stacking joiners (and compiling their bodies) is drain work
+        #: the queue-wait service rate must pay for (ISSUE 17)
+        self._admit_busy_s: float = 0.0
 
     # ---------------------------------------------------------- requests
 
@@ -1011,6 +1032,9 @@ class Scheduler:
             return scenario
         self._queue.append(scenario)
         metrics.gauge("ensemble.queue_depth", self.queue_depth())
+        if metrics.enabled and obs_cost.enabled():
+            self._advise_admission(scenario)
+        self._gauge_backlog()
         # the black box tracks the request from the moment it exists:
         # a postmortem names queued victims too, not just active ones
         flightrec.begin_request(scenario.id, tenant=scenario.tenant,
@@ -1025,6 +1049,72 @@ class Scheduler:
         """Backlog: submitted-but-not-admitted scenarios.  This is the
         load signal the PR 8 elastic policy was left waiting on."""
         return len(self._queue)
+
+    def _queued_steps(self) -> dict:
+        """Backlog member-steps per tenant (submitted, not admitted) —
+        the numerator of the predicted queue-wait estimate."""
+        out: dict = {}
+        for scn in self._queue:
+            out[scn.tenant] = out.get(scn.tenant, 0) + int(scn.steps)
+        return out
+
+    def _gauge_backlog(self) -> None:
+        """Per-tenant backlog and predicted queue-wait gauges
+        (ISSUE 17): ``ensemble.queue_depth_steps{tenant}`` is the
+        member-step backlog, ``cost.predicted_queue_wait_s{tenant}``
+        divides it by the measured service rate
+        (:class:`~dccrg_tpu.obs.cost.ServiceRateTracker`).  Tenants
+        whose backlog drained are written once more at zero, so a dead
+        backlog never freezes a stale prediction into live windows."""
+        if not metrics.enabled:
+            return
+        queued = self._queued_steps()
+        tenants = self._gauged_tenants | set(queued)
+        if not tenants:
+            return
+        waits = (obs_cost.predicted_wait(queued)
+                 if obs_cost.enabled() else {})
+        for t in sorted(tenants):
+            metrics.gauge("ensemble.queue_depth_steps",
+                          queued.get(t, 0), tenant=t)
+            metrics.gauge("cost.predicted_queue_wait_s",
+                          float(waits.get(t, 0.0)), tenant=t)
+        # drained tenants just got their zero write — drop them so an
+        # idle fleet stops paying per-tick gauge writes for every
+        # tenant it ever served
+        self._gauged_tenants = set(queued)
+
+    def _advise_admission(self, scn: Scenario) -> None:
+        """Counted-never-raised cost-based admission ADVICE (ISSUE 17):
+        estimate the request's completion — predicted queue-wait for
+        its tenant plus its steps at the model's per-step estimate —
+        against its deadline, and count the verdict under
+        ``ensemble.admission_estimates{verdict}``.  ``ok``: fits at the
+        target quantile; ``at_risk``: fits at the mean but not the
+        quantile; ``late``: predicted past the deadline even at the
+        mean; ``unknown``: no deadline, or the model is still cold.
+        This is the estimate plumbing a future reject-with-reason
+        admission policy will gate on — today nothing is refused."""
+        with metrics.phase("cost.estimate"):
+            verdict = "unknown"
+            est = obs_cost.model.predict(scn.spec.kind)
+            if (scn.deadline is not None and est is not None
+                    and est.n >= obs_cost.min_samples()):
+                wait = obs_cost.predicted_wait(
+                    self._queued_steps()).get(scn.tenant, 0.0)
+                slack = scn.deadline - time.perf_counter() - wait
+                steps = max(int(scn.steps), 0)
+                if slack < steps * est.mean:
+                    verdict = "late"
+                elif slack < steps * est.q_value:
+                    verdict = "at_risk"
+                else:
+                    verdict = "ok"
+            metrics.inc("ensemble.admission_estimates", verdict=verdict)
+            if verdict not in ("unknown", "ok"):
+                flightrec.note("request.admission_estimate",
+                               request=scn.id, tenant=scn.tenant,
+                               verdict=verdict)
 
     def _cohort_id(self, scn: Scenario) -> tuple:
         return (scn.signature, scn.spec.kind, scn.spec.kernel_key,
@@ -1066,6 +1156,7 @@ class Scheduler:
         admitted = 0
         if not self._queue:
             return 0
+        _admit_t0 = time.perf_counter()
         with metrics.phase("ensemble.admit"):
             # size new (and grown) cohorts by the whole pending backlog
             # for their key, not one member at a time — a burst of 256
@@ -1145,6 +1236,7 @@ class Scheduler:
                                cohort=cohort.sig_label,
                                queue_wait_s=round(wait, 6))
             self._queue = still
+        self._admit_busy_s += time.perf_counter() - _admit_t0
         self._update_gauges()
         return admitted
 
@@ -1163,6 +1255,7 @@ class Scheduler:
                 cohort.peak_occupancy,
                 signature=cohort.sig_label,
             )
+        self._gauge_backlog()
 
     # ---------------------------------------------------------- stepping
 
@@ -1186,11 +1279,17 @@ class Scheduler:
           (``max(remaining)`` — the in-kernel budgets already stop each
           member overshooting, this clamp stops the loop burning frozen
           iterations every member would discard);
-        * to the earliest member deadline's slack over the cohort's
-          measured per-step time EMA (a tight-deadline member must not
-          sit out a deep block it only needed the first steps of —
-          depth trades dispatch overhead against retirement latency,
-          and slack is the budget for that trade);
+        * to the earliest member deadline's slack over the per-step
+          service-time estimate (a tight-deadline member must not sit
+          out a deep block it only needed the first steps of — depth
+          trades dispatch overhead against retirement latency, and
+          slack is the budget for that trade).  The estimate is the
+          fleet cost model's ``DCCRG_COST_QUANTILE`` (default p95 —
+          a clamp sized to the mean overshoots half the time) for this
+          cohort's compiled-body key once ``DCCRG_COST_MIN_SAMPLES``
+          samples exist at the answering fallback level; below that, or
+          with ``DCCRG_COST_MODEL=0``, the cohort-local EMA exactly as
+          before (ISSUE 17);
         * to the cohort's exchange budget when wide halos engage
           (ISSUE 14) — a scheduled dispatch then pays exactly ONE
           exchange (``ceil(k/g) == 1``), which is the whole point of
@@ -1209,20 +1308,30 @@ class Scheduler:
         if active.any():
             k = min(k, int(cohort._remaining[active].max()))
         deadline = cohort.min_deadline()
-        ema = cohort.step_s_ema
-        if deadline != float("inf") and ema and ema > 0:
+        per_step = cohort.step_s_ema
+        if obs_cost.enabled():
+            est = obs_cost.model.predict(
+                cohort.spec.kind, sig=cohort.sig_label, k=k,
+                g=cohort._wide_g(k), w=cohort.W)
+            if est is not None and est.n >= obs_cost.min_samples():
+                per_step = est.q_value
+        if deadline != float("inf") and per_step and per_step > 0:
             now = time.perf_counter() if now is None else now
             slack = deadline - now
-            k = 1 if slack <= 0 else min(k, max(1, int(slack / ema)))
+            k = 1 if slack <= 0 else min(k, max(1, int(slack / per_step)))
         return max(k, 1)
 
     def step_once(self) -> int:
         """One scheduling tick: step every cohort with active members
         (policy order) at its selected dispatch depth, then retire
         finished members.  Returns total member-steps served."""
+        tick_t0 = time.perf_counter()
         served = 0
+        tick_served: dict = {}
         for cohort in self._ordered_cohorts():
             served += cohort.step(self.select_k(cohort))
+            for t, v in getattr(cohort, "_served_last", {}).items():
+                tick_served[t] = tick_served.get(t, 0) + v
             for slot in cohort.finished_slots():
                 scn = cohort.retire(int(slot))
                 self.completed.append(scn)
@@ -1233,6 +1342,16 @@ class Scheduler:
         # even between the periodic ticker's beats (no-op when no
         # stream is active or DCCRG_STREAM_FLUSH_S <= 0)
         obs_stream.maybe_flush()
+        if tick_served and obs_cost.enabled():
+            # capacity window (ISSUE 17): charge the FULL tick wall —
+            # dispatches plus retirement/gauge overhead plus any
+            # admission seconds carried since the last tick — because
+            # that is the rate a queued backlog actually drains at;
+            # the step-cost model above keeps the bare dispatch wall
+            # (it prices the compiled body, not the scheduler)
+            busy = (time.perf_counter() - tick_t0) + self._admit_busy_s
+            self._admit_busy_s = 0.0
+            obs_cost.tracker.note(tick_served, busy)
         return served
 
     def _account_retirement(self, scn: Scenario, cohort: Cohort) -> None:

@@ -1,0 +1,392 @@
+"""Cost & capacity plane (ISSUE 17): the online step-cost model (exact
+key stats, fallback chain, cross-process merge exactness), the
+chargeback ledger's conservation invariant against a real serving
+round, the capacity tracker / predicted queue-wait math, the
+model-priced ``select_k`` (and its ``DCCRG_COST_MODEL=0`` byte-identity
+escape hatch), admission estimates, and the two-tenant burst
+calibration the CI probe also gates."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+from dccrg_tpu.models import Advection
+from dccrg_tpu.obs import cost, slo
+from dccrg_tpu.serve import Ensemble
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+COST_PY = os.path.join(ROOT, "dccrg_tpu", "obs", "cost.py")
+
+
+def make_grid(n=4):
+    g = (
+        Grid()
+        .set_initial_length((n, n, n))
+        .set_neighborhood_length(0)
+        .set_periodic(True, True, True)
+        .set_geometry(CartesianGeometry, start=(0.0, 0.0, 0.0),
+                      level_0_cell_length=(1.0 / n,) * 3)
+        .initialize(mesh=make_mesh())
+    )
+    g.stop_refining()
+    return g
+
+
+def make_adv(n=4):
+    g = make_grid(n)
+    adv = Advection(g, dtype=np.float32, allow_dense=False)
+    dt = np.float32(0.4 * adv.max_time_step(adv.initialize_state()))
+    return adv, dt
+
+
+def detached_model() -> cost.StepCostModel:
+    """A StepCostModel with no registry attached (pure local store)."""
+    m = cost.StepCostModel(registry=False)
+    m._registry = None
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cost_state():
+    """Each test starts with an empty process-wide model/tracker and a
+    reset registry (the module-level singletons are process-wide)."""
+    obs.metrics.reset()
+    obs.enable()
+    cost.model.reset()
+    cost.tracker.reset()
+    yield
+    cost.model.reset()
+    cost.tracker.reset()
+
+
+# ------------------------------------------------------ model statistics
+
+
+def test_predict_exact_matches_brute_force():
+    """Exact-level estimates reproduce numpy's mean/std exactly and the
+    quantiles within one histogram bucket."""
+    m = detached_model()
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(-6.0, 0.8, size=500)
+    for v in vals:
+        m.observe("adv", "sigA", 4, 2, 8, float(v))
+    est = m.predict("adv", sig="sigA", k=4, g=2, w=8)
+    assert est is not None and est.level == "exact"
+    assert est.n == len(vals)
+    assert est.mean == pytest.approx(float(np.mean(vals)))
+    assert est.std == pytest.approx(float(np.std(vals)), rel=1e-6)
+    rel = 2.0 ** (1 / cost.COST_RESOLUTION) - 1 + 0.05
+    for q, got in ((0.5, est.p50), (0.95, est.p95)):
+        true = float(np.quantile(vals, q))
+        assert got == pytest.approx(true, rel=rel)
+    assert est.q_value == est.p95  # default DCCRG_COST_QUANTILE=0.95
+
+
+def test_fallback_chain_exact_model_global():
+    """predict walks exact -> same-model -> global, labels the level,
+    and returns None only on an empty model."""
+    m = detached_model()
+    assert m.predict("anything") is None
+    for v in (0.010, 0.011, 0.012):
+        m.observe("adv", "sigA", 4, 2, 8, v)
+    exact = m.predict("adv", sig="sigA", k=4, g=2, w=8)
+    assert exact.level == "exact" and exact.n == 3
+    # same model, different compiled-body key: model-level merge
+    other_key = m.predict("adv", sig="sigB", k=1, g=0, w=4)
+    assert other_key.level == "model" and other_key.n == 3
+    # novel model kind: global merge over everything observed
+    novel = m.predict("no-such-kind")
+    assert novel.level == "global" and novel.n == 3
+    assert novel.mean == pytest.approx(0.011)
+
+
+def test_export_ingest_equals_pooled():
+    """Ingesting two models' exports equals one model observing the
+    pooled samples — count, mean, std and quantiles all agree (the
+    invariant fleet aggregation rests on)."""
+    a, b, pooled = (detached_model() for _ in range(3))
+    rng = np.random.default_rng(11)
+    for i, v in enumerate(rng.lognormal(-5.5, 0.6, size=400)):
+        (a if i % 2 else b).observe("adv", "s", 2, 0, 4, float(v))
+        pooled.observe("adv", "s", 2, 0, 4, float(v))
+    merged = detached_model()
+    merged.ingest(a.export())
+    merged.ingest(b.export())
+    em, ep = (mm.predict("adv", sig="s", k=2, g=0, w=4)
+              for mm in (merged, pooled))
+    assert em.level == ep.level == "exact"
+    assert em.n == ep.n == 400
+    assert em.mean == pytest.approx(ep.mean)
+    assert em.std == pytest.approx(ep.std)
+    assert em.p95 == pytest.approx(ep.p95)
+
+
+def test_merge_across_processes_file_loaded():
+    """The cross-process form: a subprocess file-loads cost.py (no
+    package, no jax) and prints its export; ingesting it here equals
+    having observed those samples locally."""
+    code = (
+        "import importlib.util, json\n"
+        "spec = importlib.util.spec_from_file_location('c', %r)\n"
+        "c = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(c)\n"
+        "m = c.StepCostModel(registry=False)\n"
+        "m._registry = None\n"
+        "for i in range(40):\n"
+        "    m.observe('adv', 's', 4, 4, 8, 0.001 * (i + 1))\n"
+        "print(json.dumps(m.export()))\n" % COST_PY
+    )
+    out = subprocess.run([sys.executable, "-c", code], check=True,
+                         capture_output=True, text=True)
+    remote = json.loads(out.stdout.strip().splitlines()[-1])
+    local = detached_model()
+    for i in range(40):
+        local.observe("adv", "s", 4, 4, 8, 0.001 * (i + 1))
+    fleet = cost.StepCostModel.from_reports([remote, local.export()])
+    est = fleet.predict("adv", sig="s", k=4, g=4, w=8)
+    solo = local.predict("adv", sig="s", k=4, g=4, w=8)
+    assert est.n == 2 * solo.n
+    assert est.mean == pytest.approx(solo.mean)
+    assert est.p95 == pytest.approx(solo.p95)
+
+
+def test_observe_mirrors_into_registry_and_env_off_records_nothing(
+        monkeypatch):
+    """The dual store: every observation lands in the shared registry's
+    cost.step_s series; with DCCRG_COST_MODEL=0 the serving write seam
+    (record_dispatch) records nothing anywhere."""
+    cost.model.observe("adv", "s", 1, 0, 2, 0.004)
+    rep = obs.metrics.report()
+    series = rep["histograms"].get(cost.COST_HISTOGRAM)
+    assert series and sum(h["count"] for h in series.values()) == 1
+    label = cost.key_label("adv", "s", 1, 0, 2)
+    assert cost.model.series()[label]["count"] == 1
+    kv = cost.parse_label(label)
+    assert kv == {"model": "adv", "sig": "s", "k": "1", "g": "0", "w": "2"}
+
+
+# ------------------------------------------------------------- capacity
+
+
+def test_tracker_rates_and_window_eviction():
+    t = cost.ServiceRateTracker(window_s=10.0)
+    t.note({"a": 6, "b": 2}, busy_s=2.0, now=100.0)
+    t.note({"a": 2}, busy_s=2.0, now=102.0)
+    assert t.rate(now=103.0) == pytest.approx(10 / 4)
+    assert t.rate("a", now=103.0) == pytest.approx(8 / 4)
+    assert t.rate("b", now=103.0) == pytest.approx(2 / 4)
+    assert t.rate("cold", now=103.0) == 0.0
+    # the first record ages out of the window: totals follow
+    assert t.rate("a", now=111.0) == pytest.approx(2 / 2)
+    assert t.rate("b", now=111.0) == 0.0
+    # fully idle window
+    assert t.rate(now=130.0) == 0.0
+
+
+def test_predicted_wait_warm_and_cold_tenants():
+    """A warm tenant's wait is its backlog over its own rate; a cold
+    tenant borrows the fleet rate scaled by backlog share, which equals
+    the full FIFO drain time of everything queued."""
+    rates = lambda t: {"warm": 4.0, None: 10.0}.get(t, 0.0)  # noqa: E731
+    waits = cost.predicted_wait({"warm": 20, "cold": 5, "idle": 0},
+                                rates=rates)
+    assert waits["warm"] == pytest.approx(20 / 4.0)
+    # cold: (fleet_rate * 5/25) drains its 5 steps in 25/10 s
+    assert waits["cold"] == pytest.approx(25 / 10.0)
+    assert waits["idle"] == 0.0
+    # no resolvable rate anywhere: tenants are omitted, not invented
+    assert cost.predicted_wait({"x": 3}, rates=lambda t: 0.0) == {}
+
+
+# ----------------------------------------- serving round: conservation
+
+
+def test_chargeback_conservation_on_real_round():
+    """A real mixed-tenant serving round: per-tenant device-seconds sum
+    to the recorded wall x mesh total within one bucket, and every
+    submitting tenant appears in the ledger."""
+    adv, dt = make_adv()
+    ens = Ensemble(steps_per_dispatch=2)
+    for i in range(4):
+        ens.submit(adv, adv.initialize_state(), steps=4, dt=dt,
+                   tenant=f"t{i % 2}")
+    ens.run()
+    rep = obs.metrics.report()
+    cons = cost.conservation(rep)
+    assert cons["ok"], cons
+    ledger = cost.chargeback(rep)
+    assert {"t0", "t1"} <= set(ledger)
+    for t in ("t0", "t1"):
+        assert ledger[t]["device_s"] > 0
+        assert ledger[t]["member_steps"] == 8
+    shares = [ledger[t]["device_share"] for t in sorted(ledger)]
+    assert sum(shares) == pytest.approx(1.0)
+
+
+def test_serving_round_trains_model_and_tracker():
+    """One ensemble round leaves exact-level samples at the stepped
+    compiled-body key and a positive fleet service rate."""
+    adv, dt = make_adv()
+    ens = Ensemble(steps_per_dispatch=2)
+    ens.submit(adv, adv.initialize_state(), steps=4, dt=dt, tenant="m")
+    ens.run()
+    keys = cost.model.keys()
+    assert keys, "no cost samples after a served round"
+    kv = cost.parse_label(keys[0])
+    est = cost.model.predict(kv["model"], sig=kv["sig"], k=kv["k"],
+                             g=kv["g"], w=kv["w"])
+    assert est is not None and est.level == "exact" and est.n >= 1
+    assert cost.tracker.rate() > 0
+    assert cost.tracker.rate("m") > 0
+
+
+# ------------------------------------------------- select_k consumers
+
+
+def test_select_k_prices_slack_from_model_quantile():
+    """Once the exact key has DCCRG_COST_MIN_SAMPLES samples, select_k
+    divides deadline slack by the model's q_value instead of the EMA:
+    poisoning the key with huge samples forces depth 1."""
+    adv, dt = make_adv()
+    ens = Ensemble(steps_per_dispatch=4)
+    import time as _time
+
+    ens.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+               deadline=_time.perf_counter() + 30.0)
+    ens.admit_pending()
+    cohort = next(iter(ens.scheduler.cohorts.values()))
+    k0 = ens.scheduler.select_k(cohort)
+    assert k0 == 4  # EMA empty, generous slack: configured depth
+    # 100s/step at the cohort's exact compiled-body key: 30s of slack
+    # now affords zero whole steps -> clamped to the floor of 1
+    g = cohort._wide_g(4)
+    for _ in range(cost.min_samples()):
+        cost.model.observe(cohort.spec.kind, cohort.sig_label, 4, g,
+                           cohort.W, 100.0)
+    assert ens.scheduler.select_k(cohort) == 1
+
+
+def test_select_k_ignores_model_below_min_samples_and_when_off(
+        monkeypatch):
+    adv, dt = make_adv()
+    ens = Ensemble(steps_per_dispatch=4)
+    import time as _time
+
+    ens.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+               deadline=_time.perf_counter() + 30.0)
+    ens.admit_pending()
+    cohort = next(iter(ens.scheduler.cohorts.values()))
+    g = cohort._wide_g(4)
+    below = max(cost.min_samples() - 1, 1)
+    for _ in range(below):
+        cost.model.observe(cohort.spec.kind, cohort.sig_label, 4, g,
+                           cohort.W, 100.0)
+    assert ens.scheduler.select_k(cohort) == 4  # still the EMA path
+    for _ in range(cost.min_samples()):
+        cost.model.observe(cohort.spec.kind, cohort.sig_label, 4, g,
+                           cohort.W, 100.0)
+    assert ens.scheduler.select_k(cohort) == 1  # model engages
+    monkeypatch.setenv("DCCRG_COST_MODEL", "0")
+    assert ens.scheduler.select_k(cohort) == 4  # kill switch restores
+
+
+def test_results_byte_identical_with_model_on_and_off(monkeypatch):
+    """The escape hatch's real guarantee: whatever depths the model
+    prices, served results stay bit-identical to the EMA-only
+    scheduler's (depth changes batching, never arithmetic)."""
+    finals = {}
+    import time as _time
+
+    for setting in ("1", "0"):
+        monkeypatch.setenv("DCCRG_COST_MODEL", setting)
+        cost.model.reset()
+        cost.tracker.reset()
+        adv, dt = make_adv()
+        ens = Ensemble(steps_per_dispatch=2)
+        tickets = [
+            ens.submit(adv, adv.initialize_state(), steps=4, dt=dt,
+                       tenant=f"t{i}",
+                       deadline=_time.perf_counter() + 60.0)
+            for i in range(2)
+        ]
+        ens.run()
+        finals[setting] = [
+            {k: np.asarray(v).tobytes()
+             for k, v in sorted(t.result.items())}
+            for t in tickets
+        ]
+    assert finals["1"] == finals["0"]
+
+
+# ------------------------------------------- admission + calibration
+
+
+def test_admission_estimates_counted_never_raised():
+    """Every submit counts a verdict; a cold model says unknown, a
+    poisoned model says late for an impossible deadline — and nothing
+    is ever refused (the scenario still runs to completion)."""
+    adv, dt = make_adv()
+    ens = Ensemble(steps_per_dispatch=2)
+    import time as _time
+
+    ens.submit(adv, adv.initialize_state(), steps=2, dt=dt)
+
+    def verdicts():
+        rep = obs.metrics.report()
+        series = rep["counters"].get("ensemble.admission_estimates", {})
+        return {cost.parse_label(lb)["verdict"]: int(v)
+                for lb, v in series.items()}
+
+    assert verdicts().get("unknown", 0) == 1  # no deadline, cold model
+    for _ in range(cost.min_samples()):
+        cost.model.observe(adv.batch_step_spec().kind, "s", 1, 0, 1,
+                           100.0)
+    t = ens.submit(adv, adv.initialize_state(), steps=2, dt=dt,
+                   deadline=_time.perf_counter() + 0.001)
+    assert verdicts().get("late", 0) == 1
+    ens.run()
+    assert t.result is not None  # advice never blocked admission
+
+
+def test_burst_calibration_within_bucket():
+    """The acceptance claim the CI probe also gates: submit-time
+    predicted queue-waits for a two-tenant burst into a width-capped
+    fleet bracket the measured per-tenant p95 within one
+    CALIBRATION_BUCKET."""
+    adv, dt = make_adv()
+    burst = Ensemble(steps_per_dispatch=4, max_width=4)
+    for _ in range(4):
+        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                     tenant="warm")
+    burst.run()                  # compiles the (W=4, k=4) body
+    cost.tracker.reset()         # drop compile-inflated timings
+    for _ in range(4):
+        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                     tenant="warm")
+    burst.run()                  # clean wave trains the rate window
+    for i in range(16):
+        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                     tenant=f"b{i % 2}")
+    predicted = {
+        cost.parse_label(lb).get("tenant"): float(v)
+        for lb, v in (obs.metrics.report()["gauges"]
+                      .get("cost.predicted_queue_wait_s") or {}).items()
+    }
+    burst.run()
+    waits = obs.metrics.report()["histograms"]["ensemble.queue_wait_s"]
+    for tenant in ("b0", "b1"):
+        pred = predicted.get(tenant)
+        assert pred and pred > 0, f"no submit-time prediction: {tenant}"
+        measured = slo.quantile(waits[f"tenant={tenant}"], 0.95)
+        assert measured and measured > 0
+        ratio = pred / measured
+        assert 1.0 / cost.CALIBRATION_BUCKET <= ratio \
+            <= cost.CALIBRATION_BUCKET, (
+                f"{tenant}: predicted {pred:.4f}s vs measured p95 "
+                f"{measured:.4f}s (ratio {ratio:.2f})")

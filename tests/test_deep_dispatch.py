@@ -234,6 +234,11 @@ def test_select_k_clamps_to_remaining_steps():
 
 
 def test_select_k_deadline_slack_and_cap(monkeypatch):
+    # pin the EMA pricing path: with the fleet cost model armed the
+    # slack clamp would price from OTHER cohorts' pooled samples even
+    # before this cohort has an EMA (the model-driven path is covered
+    # by tests/test_cost.py)
+    monkeypatch.setenv("DCCRG_COST_MODEL", "0")
     g = make_grid()
     gol = GameOfLife(g, allow_dense=False)
     state = gol_states(gol, g, 1, seed=7)[0]

@@ -103,6 +103,9 @@ REQUIRED_PHASES = (
     "ensemble.step",
     # ISSUE 10: the forced escalation must write its black box
     "flightrec.dump",
+    # ISSUE 17: every submission with the cost model armed times its
+    # admission estimate
+    "cost.estimate",
 )
 
 #: counters that must be nonzero after the workload
@@ -153,6 +156,11 @@ REQUIRED_NONZERO_COUNTERS = (
     # and the forced escalation must leave its postmortem evidence
     "ensemble.deadline_miss",
     "flightrec.dumps",
+    # ISSUE 17: the cost plane's evidence — admission verdicts counted
+    # on every submit, and the conservation companion every dispatch
+    # bills wall×mesh device-seconds into
+    "ensemble.admission_estimates",
+    "ensemble.device_s_total",
 )
 
 #: histograms that must carry samples after the probe (ISSUE 10): the
@@ -164,6 +172,9 @@ REQUIRED_HISTOGRAMS = (
     "ensemble.service_s",
     "ensemble.e2e_s",
     "phase.duration_s",
+    # ISSUE 17: the per-key step-cost distributions the online model
+    # (and its cross-process merges) are built from
+    "cost.step_s",
 )
 
 
@@ -1141,6 +1152,165 @@ def _slo_probe() -> list:
     return failures
 
 
+def _cost_probe() -> list:
+    """Cost & capacity round (ISSUE 17).
+
+    Drives a mixed-tenant ensemble round with the cost model armed and
+    requires the predictive plane to materialize: every stepped
+    compiled-body key must have samples in BOTH the process model and
+    the exported ``cost.step_s`` series (the dual store cross-process
+    merges depend on), ``predict`` must answer at the exact level for a
+    stepped key and walk the fallback chain to ``global`` for a novel
+    model kind, and the chargeback conservation invariant must hold
+    (per-tenant ``ensemble.device_s`` sums to the recorded
+    ``ensemble.device_s_total`` wall×mesh total).  Then the adversarial
+    calibration round: a two-tenant burst into a width-capped cohort so
+    requests queue, comparing the ``cost.predicted_queue_wait_s``
+    gauges read at submit time against the measured per-tenant
+    queue-wait p95 — they must agree within one octave bucket
+    (``cost.CALIBRATION_BUCKET``, the predictor's documented
+    calibration resolution).  No deadlines are used, so the
+    ``ensemble.deadline_miss`` count stays exactly the SLO probe's
+    (the telemetry_diff gate pins it).  The ≤5% overhead budget is
+    re-passed with the model ON by construction: ``_overhead_probe``
+    runs in this same process with the default (armed) cost env, which
+    this probe asserts.  Returns failure strings."""
+    import numpy as np
+
+    from dccrg_tpu import CartesianGeometry, Grid, make_mesh, obs
+    from dccrg_tpu.models import Advection
+    from dccrg_tpu.obs import cost, slo
+    from dccrg_tpu.serve import Ensemble
+
+    failures: list = []
+    try:
+        if not cost.enabled():
+            return ["cost probe: DCCRG_COST_MODEL is off — the probe "
+                    "(and the overhead budget) must run with the model "
+                    "armed"]
+        # The probe serves the paper's advection model on its own tiny
+        # grid (NOT the gol the other ensemble probes drive): the
+        # ceiling-gated per-model gauges are latest-wins (hbm) and
+        # process-cumulative (exchanges_per_step), so this probe's
+        # legacy hood-0 k=4 cohorts would otherwise overwrite/dilute
+        # the canonical gol series the wide-halo and slo probes leave
+        # behind.  Under its own ``model=advection*`` labels the cost
+        # rounds get their own gated baseline instead.
+        n = 4
+        g = (
+            Grid()
+            .set_initial_length((n, n, n))
+            .set_neighborhood_length(0)
+            .set_periodic(True, True, True)
+            .set_geometry(
+                CartesianGeometry,
+                start=(0.0, 0.0, 0.0),
+                level_0_cell_length=(1.0 / n,) * 3,
+            )
+            .initialize(mesh=make_mesh())
+        )
+        g.stop_refining()
+        adv = Advection(g, dtype=np.float32, allow_dense=False)
+        dt = np.float32(0.4 * adv.max_time_step(adv.initialize_state()))
+        mk = adv.initialize_state
+
+        # (1) mixed-tenant round: every stepped key leaves samples
+        ens = Ensemble(steps_per_dispatch=4)
+        for i in range(4):
+            ens.submit(adv, mk(), steps=8, dt=dt, tenant=f"ct{i % 2}")
+        ens.run()
+        rep = obs.metrics.report()
+        series = rep["histograms"].get(cost.COST_HISTOGRAM) or {}
+        if not series:
+            failures.append(
+                "cost probe: no cost.step_s series after the "
+                "mixed-tenant round")
+        local = cost.model.series()
+        for label, h in series.items():
+            mine = local.get(label)
+            if mine is None or mine["count"] < h["count"]:
+                failures.append(
+                    f"cost probe: model/registry divergence at "
+                    f"{label!r} — the dual store cross-process merges "
+                    "depend on is out of sync")
+        for label in series:
+            kv = cost.parse_label(label)
+            est = cost.model.predict(kv["model"], sig=kv["sig"],
+                                     k=kv["k"], g=kv["g"], w=kv["w"])
+            if est is None or est.level != "exact" or est.n < 1:
+                failures.append(
+                    f"cost probe: predict({label!r}) did not answer at "
+                    f"the exact level: {est}")
+        novel = cost.model.predict("no-such-model-kind")
+        if novel is None or novel.level != "global":
+            failures.append(
+                "cost probe: fallback chain broken — a novel model "
+                f"kind must answer at the global level, got {novel}")
+
+        # (2) chargeback conservation over everything recorded so far
+        cons = cost.conservation(rep)
+        if not cons["ok"]:
+            failures.append(
+                f"cost probe: chargeback conservation violated — "
+                f"attributed {cons['attributed']:.6f}s vs wall×mesh "
+                f"total {cons['total']:.6f}s (ratio {cons['ratio']})")
+        ledger = cost.chargeback(rep)
+        if not any(t.startswith("ct") for t in ledger):
+            failures.append(
+                f"cost probe: mixed-tenant round missing from the "
+                f"chargeback ledger: {sorted(ledger)}")
+
+        # (3) adversarial calibration: two-tenant burst, width-capped
+        # cohort (16 pending into width 4, so most requests queue),
+        # prediction at submit time vs measured wait p95
+        burst = Ensemble(steps_per_dispatch=4, max_width=4)
+        for _ in range(4):
+            burst.submit(adv, mk(), steps=8, dt=dt, tenant="cwarm")
+        burst.run()                  # compiles the (W=4, k=4) body
+        cost.tracker.reset()         # drop compile-inflated timings
+        for _ in range(4):
+            burst.submit(adv, mk(), steps=8, dt=dt, tenant="cwarm")
+        burst.run()                  # clean wave trains the rate window
+        for i in range(16):
+            burst.submit(adv, mk(), steps=8, dt=dt,
+                         tenant=f"cburst{i % 2}")
+        predicted = {
+            cost.parse_label(label).get("tenant"): float(v)
+            for label, v in (obs.metrics.report()["gauges"]
+                             .get("cost.predicted_queue_wait_s") or {})
+            .items()
+        }
+        burst.run()
+        rep = obs.metrics.report()
+        waits = rep["histograms"].get("ensemble.queue_wait_s") or {}
+        for tenant in ("cburst0", "cburst1"):
+            pred = predicted.get(tenant)
+            if not pred or pred <= 0:
+                failures.append(
+                    f"cost probe: no predicted queue-wait gauge for "
+                    f"burst tenant {tenant!r} at submit time")
+                continue
+            h = waits.get(f"tenant={tenant}")
+            measured = slo.quantile(h, 0.95) if h else None
+            if not measured:
+                failures.append(
+                    f"cost probe: no measured queue-wait for burst "
+                    f"tenant {tenant!r}")
+                continue
+            ratio = pred / measured
+            b = cost.CALIBRATION_BUCKET
+            if not (1.0 / b <= ratio <= b):
+                failures.append(
+                    f"cost probe: predicted queue-wait off by more "
+                    f"than one calibration bucket for {tenant!r}: "
+                    f"predicted {pred:.4f}s vs measured p95 "
+                    f"{measured:.4f}s (ratio {ratio:.2f}, "
+                    f"envelope [{1.0 / b:.2f}, {b:.2f}])")
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"cost probe failed: {e!r}")
+    return failures
+
+
 #: the live-probe stream writer: file-loads the registry (stdlib-only
 #: by contract, so the subprocess never pays a jax import), records a
 #: DETERMINISTIC sample schedule into the SLO series at the SLO bucket
@@ -1519,6 +1689,12 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     failures += _live_probe(g, adv, state, dt, steps,
                             reps=reps, threshold=threshold,
                             skip_overhead=skip_overhead)
+    # after the timed overhead reps for the same reason as the xplane
+    # round: the cost probe's burst ensembles allocate enough that
+    # their GC debt would land inside the 5% budget's timed halves
+    # (the budget is still measured with the cost model armed —
+    # DCCRG_COST_MODEL defaults on, asserted inside the probe)
+    failures += _cost_probe()
     failures += _elastic_probe(g, state)
     failures += _device_timeline_probe(
         g, adv, state, dt, out_path,

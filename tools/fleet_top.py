@@ -13,9 +13,18 @@ windowed snapshot — or refreshes in place with ``--follow``::
     python tools/fleet_top.py run/ --json -            # machine-readable
     python tools/fleet_top.py run/ --prometheus fleet.prom
     python tools/fleet_top.py run/ --alerts            # rule states too
+    python tools/fleet_top.py run/ --cost              # cost & capacity
 
-This tool file-loads ``dccrg_tpu/obs/live.py`` (stdlib-only by
-contract), so watching a fleet never imports jax.
+Every snapshot leads with a per-writer table including each stream's
+staleness (``age_s`` — seconds since its last snapshot): a silent dead
+writer otherwise just freezes its numbers into every window.  With
+``--cost`` the snapshot adds the cost & capacity section (ISSUE 17):
+the fleet step-cost model table, the per-tenant chargeback ledger with
+its conservation check, and predicted queue-waits.
+
+This tool file-loads ``dccrg_tpu/obs/live.py`` (and ``--cost`` loads
+``obs/cost.py`` — both stdlib-only by contract), so watching a fleet
+never imports jax.
 """
 from __future__ import annotations
 
@@ -95,6 +104,16 @@ def print_snapshot(snap: dict, qs, alerts=None) -> None:
           f"files={h['files']} ({h['stale_files']} stale)  "
           f"records={h['records']}  seq_gaps={h['seq_gaps']}  "
           f"torn_tails={h['torn_tails']}  bad_lines={h['bad_lines']}")
+    files = snap.get("files") or []
+    if files:
+        print(f"{'writer':36s} {'age_s':>8s} {'seq':>8s} {'gaps':>5s} "
+              f"{'torn':>5s}")
+        for f in sorted(files, key=lambda f: -f["age_s"]):
+            name = pathlib.Path(f["path"]).name
+            seq = f.get("seq")
+            print(f"{name:36s} {f['age_s']:>8.1f} "
+                  f"{'n/a' if seq is None else seq:>8} "
+                  f"{f['seq_gaps']:>5d} {f['torn_tails']:>5d}")
     qcols = [f"p{round(q * 100):d}" for q in qs]
     if snap["latency"]:
         head = (f"{'metric':24s} {'labels':28s} {'count':>7s} "
@@ -132,6 +151,56 @@ def print_snapshot(snap: dict, qs, alerts=None) -> None:
             print(f"{name:28s} {st['status']:8s} "
                   f"{'n/a' if v is None else f'{v:12.4g}'} "
                   f"{st['fires']:>6d}")
+    if snap.get("cost") is not None:
+        print_cost(snap["cost"])
+
+
+def cost_section(view, cost_mod) -> dict:
+    """The ``--cost`` snapshot section: the fleet cost model and
+    ledger from the cumulative merge, plus windowed read-side
+    queue-wait estimates (bucket-delta service rates)."""
+    out = cost_mod.cost_summary(view.cumulative_report)
+    out["queue_wait_estimates"] = cost_mod.queue_wait_estimates(view)
+    return out
+
+
+def print_cost(cost: dict) -> None:
+    rows = cost.get("model") or []
+    print()
+    if rows:
+        print(f"{'cost model key':44s} {'n':>6s} {'mean(ms)':>9s} "
+              f"{'p50(ms)':>9s} {'p95(ms)':>9s}")
+        for r in rows:
+            print(f"{r['key']:44s} {r['n']:>6d} "
+                  f"{r['mean_s'] * 1e3:>9.3f} "
+                  f"{r.get('p50_s', 0.0) * 1e3:>9.3f} "
+                  f"{r.get('p95_s', 0.0) * 1e3:>9.3f}")
+    else:
+        print("  (no cost-model samples)")
+    ledger = cost.get("chargeback") or {}
+    if ledger:
+        print()
+        print(f"{'tenant':16s} {'device_s':>10s} {'share':>7s} "
+              f"{'steps':>8s} {'halo_ex':>9s} {'compile_s':>9s}")
+        for tenant, rec in sorted(ledger.items()):
+            print(f"{tenant:16s} {rec['device_s']:>10.3f} "
+                  f"{rec['device_share']:>7.2%} "
+                  f"{rec['member_steps']:>8d} "
+                  f"{rec['halo_exchanges']:>9.0f} "
+                  f"{rec['compile_s']:>9.3f}")
+        cons = cost.get("conservation") or {}
+        ratio = cons.get("ratio")
+        print(f"conservation: attributed={cons.get('attributed', 0.0):.3f}s "
+              f"total={cons.get('total', 0.0):.3f}s "
+              f"ratio={'n/a' if ratio is None else f'{ratio:.4f}'} "
+              f"{'OK' if cons.get('ok') else 'VIOLATED'}")
+    waits = {**(cost.get("predicted_queue_wait_s") or {}),
+             **(cost.get("queue_wait_estimates") or {})}
+    if waits:
+        print()
+        print(f"{'tenant':16s} {'predicted_wait_s':>16s}")
+        for tenant, w in sorted(waits.items()):
+            print(f"{tenant:16s} {w:>16.3f}")
 
 
 def main(argv=None) -> int:
@@ -157,6 +226,10 @@ def main(argv=None) -> int:
     ap.add_argument("--alerts", action="store_true",
                     help="evaluate the alert rules (DCCRG_ALERT_RULES "
                          "or the shipped defaults) against each view")
+    ap.add_argument("--cost", action="store_true",
+                    help="add the cost & capacity section: step-cost "
+                         "model, chargeback ledger + conservation, "
+                         "predicted queue-waits")
     ap.add_argument("--follow", action="store_true",
                     help="refresh in place every --refresh seconds")
     ap.add_argument("--refresh", type=float, default=2.0,
@@ -180,6 +253,7 @@ def main(argv=None) -> int:
     sources = (args.sources[0]
                if len(args.sources) == 1 and not paths else paths)
     agg = live.FleetAggregator(sources, window_s=args.window)
+    cost_mod = _load("cost") if args.cost else None
     engine = None
     if args.alerts:
         alerts_mod = _load("alerts")
@@ -197,6 +271,8 @@ def main(argv=None) -> int:
         snap = snapshot(view, metrics, qs)
         if alert_states is not None:
             snap["alerts"] = alert_states
+        if cost_mod is not None:
+            snap["cost"] = cost_section(view, cost_mod)
         if args.prometheus:
             text = live.to_prometheus(view.window_report)
             if args.prometheus == "-":

@@ -234,6 +234,15 @@ import bench
 out = bench.measure_wide_halo()
 print(json.dumps(out))
 """, 1500),
+    # ISSUE 17: cost-model-armed vs EMA-only deadline burst — on a real
+    # accelerator the per-dispatch cost the model prices includes the
+    # host round-trip and ICI exchanges, so informed depth selection
+    # has more room to move the miss rate than on the CPU mesh
+    "cost_model": ("""
+import bench
+out = bench.measure_cost_model()
+print(json.dumps(out))
+""", 1500),
     "large": ("import bench\nprint(json.dumps(bench.measure_large()))", 1500),
     "flat_kernel_sweep_Bvox_per_s": ("""
 import tools.flat_kernel_bench as fkb

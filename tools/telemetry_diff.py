@@ -71,6 +71,13 @@ DEFAULT_PHASES = (
 #: shape-stable-epoch contract even if each compile stayed cheap
 GATED_COUNTERS = (
     "epoch.recompiles",
+    # ISSUE 17: the model-driven select_k slack clamp prices dispatch
+    # width from pooled step-cost quantiles instead of the cohort EMA —
+    # the one regression that pricing change could introduce is MISSING
+    # MORE DEADLINES.  The probe workload pins the count (the SLO probe
+    # produces exactly its scripted misses; the cost probe submits no
+    # deadlines), so any rise here is the clamp mispricing, not noise.
+    "ensemble.deadline_miss",
 )
 
 #: counters REPORTED round-over-round but never failed (ISSUE 16): how
@@ -210,6 +217,12 @@ DEFAULT_ALLOW = (
     # OUTCOME is surfaced via the informational alerts.fired counter.
     "live.poll",
     "alerts.evaluate",
+    # ISSUE 17 cost plane: an admission estimate runs once per submitted
+    # scenario, so its total scales with how many scenarios a probe
+    # round submits — workload-shaped.  The OUTCOME the gate watches is
+    # ensemble.deadline_miss (GATED_COUNTERS above): the model-driven
+    # clamp must not miss more deadlines than the EMA-only baseline.
+    "cost.estimate",
 )
 
 #: gauges gated round-over-round where a DROP is the regression: the
