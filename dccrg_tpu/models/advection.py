@@ -961,15 +961,23 @@ class Advection:
             mzu3 = jnp.asarray(zface_up[0], dtype).reshape(nzl, 1, 1)
             mzd3 = jnp.asarray(zface_dn[0], dtype).reshape(nzl, 1, 1)
 
+            # face masks as runtime-argument tables (ROADMAP item 4):
+            # the jitted body is table-content-independent — the masks
+            # are plain pallas-kernel operands either way, so lifting
+            # them through the jit boundary cannot perturb the kernel —
+            # and only the plain wrapper closes over the device copies
             @jax.jit
-            def fused_run_fn(state, steps, dt):
+            def fused_run_fn(masks, state, steps, dt):
+                fmx, fmy, fmzu, fmzd = masks
                 new_rho = fused(
                     state["density"][0], state["vx"][0], state["vy"][0],
-                    state["vz"][0], mx3, my3, mzu3, mzd3, dt, steps,
+                    state["vz"][0], fmx, fmy, fmzu, fmzd, dt, steps,
                 )
                 return {**state, "density": new_rho[None]}
 
-            fused_run = fused_run_fn
+            def fused_run(state, steps, dt):
+                return fused_run_fn(
+                    (mx3, my3, mzu3, mzd3), state, steps, dt)
 
         # Blocked multi-step run: the whole fori_loop inside one shard_map
         # so the constant vz halo stacks are built once per run call, not

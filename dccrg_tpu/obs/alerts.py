@@ -284,7 +284,21 @@ def default_rules() -> list:
             "DCCRG_ELASTIC_QUEUE_TARGET", "8"))
     except ValueError:
         queue_target = 8.0
+    try:
+        stall = float(os.environ.get("DCCRG_GATEWAY_STALL_S", "10"))
+    except ValueError:
+        stall = 10.0
     return [
+        # worker-lost (ISSUE 19): a worker heartbeat stream whose
+        # ``stream.age_s`` gauge exceeds 3x the gateway stall budget is
+        # a dead/wedged worker — the same signal the gateway's
+        # per-worker HeartbeatMonitor escalates on, surfaced through
+        # the alert plane so a Supervisor wired with this engine (its
+        # ``alerts=`` hook) climbs the ladder even when only the
+        # merged fleet view sees the silence
+        AlertRule("worker-lost", "stream.age_s",
+                  source="gauge", kind="ceiling",
+                  threshold=3.0 * stall, clear=stall, for_s=0.0),
         AlertRule("deadline-miss-rate", "ensemble.deadline_miss",
                   source="miss_rate", kind="ceiling",
                   threshold=0.05, clear=0.01, for_s=0.0),

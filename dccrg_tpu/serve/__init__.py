@@ -1,7 +1,7 @@
-"""Ensemble serving front-end (ISSUE 9): multiplex thousands of
-independent same-signature scenarios through one compiled executable.
+"""Ensemble serving front-end (ISSUE 9) and fleet gateway (ISSUE 19).
 
-See :mod:`dccrg_tpu.serve.ensemble` for the design; the short version:
+See :mod:`dccrg_tpu.serve.ensemble` for the single-process design; the
+short version:
 
 * :class:`Ensemble` — submit ``(model, state, steps)`` scenarios, run
   the loop, read bit-identical-to-solo results;
@@ -10,6 +10,15 @@ See :mod:`dccrg_tpu.serve.ensemble` for the design; the short version:
 * :class:`Cohort` — one signature's stacked member fleet and its single
   jitted step body;
 * ``DCCRG_ENSEMBLE_VERIFY=1`` — the solo-replay byte-compare oracle.
+
+:mod:`dccrg_tpu.serve.gateway` scales that loop across per-worker
+failure domains:
+
+* :class:`Gateway` — crash-durable submissions
+  (:class:`SubmissionJournal`), enforced admission, signature-affinity
+  routing, worker-loss redispatch with exactly-once retirement;
+* :class:`WorkerHandle` — one supervised worker process
+  (:mod:`dccrg_tpu.serve.worker` is its loop).
 """
 from .ensemble import (
     Cohort,
@@ -21,14 +30,26 @@ from .ensemble import (
     shared_tables_enabled,
     verify_enabled,
 )
+from .gateway import (
+    Gateway,
+    SubmissionJournal,
+    WorkerHandle,
+    admission_enabled,
+    gateway_queue_max,
+)
 
 __all__ = [
     "Cohort",
     "Ensemble",
+    "Gateway",
     "Scenario",
     "Scheduler",
+    "SubmissionJournal",
+    "WorkerHandle",
+    "admission_enabled",
     "cohort_width",
     "donation_enabled",
+    "gateway_queue_max",
     "shared_tables_enabled",
     "verify_enabled",
 ]

@@ -755,6 +755,17 @@ class Cohort:
                if m is not None and m.deadline is not None]
         return min(dls) if dls else float("inf")
 
+    def min_deadline_tenant(self) -> str | None:
+        """Tenant of the earliest-deadline member (None without one) —
+        the identity whose predicted queue wait charges the slack
+        clamp when the cost plane is armed (ROADMAP item 3 (b))."""
+        best, tenant = float("inf"), None
+        for m in self.members:
+            if m is not None and m.deadline is not None \
+                    and m.deadline < best:
+                best, tenant = m.deadline, m.tenant
+        return tenant
+
     # -------------------------------------------------------------- step
 
     def active_mask(self) -> np.ndarray:
@@ -1309,15 +1320,29 @@ class Scheduler:
             k = min(k, int(cohort._remaining[active].max()))
         deadline = cohort.min_deadline()
         per_step = cohort.step_s_ema
+        queue_wait = 0.0
         if obs_cost.enabled():
             est = obs_cost.model.predict(
                 cohort.spec.kind, sig=cohort.sig_label, k=k,
                 g=cohort._wide_g(k), w=cohort.W)
             if est is not None and est.n >= obs_cost.min_samples():
                 per_step = est.q_value
+                # ROADMAP item 3 follow-on (b): an ARMED cost plane
+                # spends the slack clamp from item 2's admission
+                # estimates, not just the compiled-body cost — the
+                # earliest-deadline member's usable slack is reduced by
+                # its tenant's predicted queue wait (backlog it must
+                # still drain behind).  Cold model or
+                # DCCRG_COST_MODEL=0 keeps the EMA path untouched, and
+                # either way k only changes dispatch granularity — the
+                # oracle holds results byte-identical at every depth.
+                tenant = cohort.min_deadline_tenant()
+                if tenant is not None and deadline != float("inf"):
+                    waits = obs_cost.predicted_wait(self._queued_steps())
+                    queue_wait = float(waits.get(tenant, 0.0))
         if deadline != float("inf") and per_step and per_step > 0:
             now = time.perf_counter() if now is None else now
-            slack = deadline - now
+            slack = deadline - now - queue_wait
             k = 1 if slack <= 0 else min(k, max(1, int(slack / per_step)))
         return max(k, 1)
 

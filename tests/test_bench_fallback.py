@@ -180,7 +180,10 @@ def test_partial_record_recovered_on_mid_bench_timeout(sandbox, monkeypatch):
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         bench.main()
-    assert len(calls) == 3  # telemetry probe + tunnel probe + real child
+    # telemetry probe + tunnel probe + real child + cost probe (the
+    # cost attach also times out here; its failure never blocks the
+    # recovered record)
+    assert len(calls) == 4
     line = buf.getvalue().strip().splitlines()[-1]
     d = json.loads(line)
     assert d["value"] == 5.3e10 and d["vs_baseline"] == 810.0

@@ -358,35 +358,56 @@ def test_burst_calibration_within_bucket():
     """The acceptance claim the CI probe also gates: submit-time
     predicted queue-waits for a two-tenant burst into a width-capped
     fleet bracket the measured per-tenant p95 within one
-    CALIBRATION_BUCKET."""
-    adv, dt = make_adv()
-    burst = Ensemble(steps_per_dispatch=4, max_width=4)
-    for _ in range(4):
-        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
-                     tenant="warm")
-    burst.run()                  # compiles the (W=4, k=4) body
-    cost.tracker.reset()         # drop compile-inflated timings
-    for _ in range(4):
-        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
-                     tenant="warm")
-    burst.run()                  # clean wave trains the rate window
-    for i in range(16):
-        burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
-                     tenant=f"b{i % 2}")
-    predicted = {
-        cost.parse_label(lb).get("tenant"): float(v)
-        for lb, v in (obs.metrics.report()["gauges"]
-                      .get("cost.predicted_queue_wait_s") or {}).items()
-    }
-    burst.run()
-    waits = obs.metrics.report()["histograms"]["ensemble.queue_wait_s"]
-    for tenant in ("b0", "b1"):
-        pred = predicted.get(tenant)
-        assert pred and pred > 0, f"no submit-time prediction: {tenant}"
-        measured = slo.quantile(waits[f"tenant={tenant}"], 0.95)
-        assert measured and measured > 0
-        ratio = pred / measured
-        assert 1.0 / cost.CALIBRATION_BUCKET <= ratio \
-            <= cost.CALIBRATION_BUCKET, (
-                f"{tenant}: predicted {pred:.4f}s vs measured p95 "
-                f"{measured:.4f}s (ratio {ratio:.2f})")
+    CALIBRATION_BUCKET.
+
+    Wall-clock-calibrated on an oversubscribed host, so it borrows the
+    ``_overhead_probe`` discipline: collect garbage first (a GC pause
+    landing inside the burst but not the training wave skews the rate
+    the prediction was priced from) and confirm a failed measurement
+    with ONE re-measure under fresh tenant labels — a real
+    miscalibration fails both attempts."""
+    import gc
+
+    def measure(tag):
+        adv, dt = make_adv()
+        burst = Ensemble(steps_per_dispatch=4, max_width=4)
+        for _ in range(4):
+            burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                         tenant="warm")
+        burst.run()                  # compiles the (W=4, k=4) body
+        cost.tracker.reset()         # drop compile-inflated timings
+        for _ in range(4):
+            burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                         tenant="warm")
+        burst.run()                  # clean wave trains the rate window
+        for i in range(16):
+            burst.submit(adv, adv.initialize_state(), steps=8, dt=dt,
+                         tenant=f"{tag}{i % 2}")
+        predicted = {
+            cost.parse_label(lb).get("tenant"): float(v)
+            for lb, v in (obs.metrics.report()["gauges"]
+                          .get("cost.predicted_queue_wait_s") or {}).items()
+        }
+        burst.run()
+        waits = obs.metrics.report()["histograms"]["ensemble.queue_wait_s"]
+        rows = []
+        for tenant in (f"{tag}0", f"{tag}1"):
+            pred = predicted.get(tenant)
+            assert pred and pred > 0, f"no submit-time prediction: {tenant}"
+            measured = slo.quantile(waits[f"tenant={tenant}"], 0.95)
+            assert measured and measured > 0
+            rows.append((tenant, pred, measured, pred / measured))
+        return rows
+
+    lo, hi = 1.0 / cost.CALIBRATION_BUCKET, cost.CALIBRATION_BUCKET
+    gc.collect()
+    rows = measure("b")
+    if not all(lo <= r[3] <= hi for r in rows):
+        gc.collect()
+        # fresh labels: the queue-wait histograms are cumulative, so a
+        # retry under "b*" would mix both attempts' samples
+        rows = measure("c")
+    for tenant, pred, measured, ratio in rows:
+        assert lo <= ratio <= hi, (
+            f"{tenant}: predicted {pred:.4f}s vs measured p95 "
+            f"{measured:.4f}s (ratio {ratio:.2f}), confirmed twice")

@@ -407,7 +407,8 @@ def test_alert_counters_and_default_rules():
     assert "alerts.evaluate" in reg.report()["phases"]
     names = {r.name for r in alerts.default_rules()}
     assert names == {"deadline-miss-rate", "queue-depth",
-                     "halo-exchanges-per-step", "overlap-fraction"}
+                     "halo-exchanges-per-step", "overlap-fraction",
+                     "worker-lost"}
 
 
 def test_load_rules_and_env(tmp_path, monkeypatch):

@@ -55,6 +55,16 @@ Checks (exit 1 on any failure):
   produce exactly ONE schema-valid postmortem dump naming the round's
   requests (``obs.validate_flightrec``); the overhead budget below runs
   with the whole request plane on;
+* a fleet round (ISSUE 19): two real worker subprocesses on 4-device
+  mesh slices behind an in-process gateway; one worker is SIGKILLed
+  after it starts stepping and its in-flight scenarios must redispatch
+  to the survivor with every accepted scenario retiring EXACTLY once
+  (one redispatched member byte-compared against uninterrupted solo
+  stepping), one overflow submission must be rejected at the pinned
+  queue bound, the loss must leave exactly ONE schema-valid postmortem
+  naming the dead worker, and a journal reopen must replay the retired
+  state (``gateway.{accepted,rejected,redispatched,journal_replays}``
+  all required nonzero);
 * side artifacts (``<out>.stream.jsonl`` / ``.trace.json`` /
   ``.merged_trace.json``) land next to ``--out`` — or under ``tools/``
   when ``--out`` is the repo root's ``telemetry.json``, keeping bench
@@ -161,6 +171,15 @@ REQUIRED_NONZERO_COUNTERS = (
     # bills wall×mesh device-seconds into
     "ensemble.admission_estimates",
     "ensemble.device_s_total",
+    # ISSUE 19: the fleet probe's forced failure round must leave the
+    # whole gateway evidence trail — an accepted fleet, an enforced
+    # rejection at the pinned queue bound, the kill's redispatch, and a
+    # journal reopen that counts its replay.  Any of these at zero
+    # means the fault-tolerance plane silently lost coverage.
+    "gateway.accepted",
+    "gateway.rejected",
+    "gateway.redispatched",
+    "gateway.journal_replays",
 )
 
 #: histograms that must carry samples after the probe (ISSUE 10): the
@@ -1152,6 +1171,208 @@ def _slo_probe() -> list:
     return failures
 
 
+def _fleet_probe() -> list:
+    """Fleet gateway round (ISSUE 19).
+
+    Launches TWO real worker subprocesses on 4-device mesh slices
+    behind an in-process :class:`~dccrg_tpu.serve.Gateway` (in-process
+    so the gateway counters land in THIS registry, where the gate's
+    required-counter check reads them), submits a small GoL fleet, and
+    forces the failure path end to end: one worker is SIGKILLed after
+    it reports ``started``, its in-flight scenarios must redispatch to
+    the survivor and every accepted scenario must retire EXACTLY once
+    — with one redispatched member byte-compared against uninterrupted
+    solo stepping.  The queue bound is pinned low enough that one
+    overflow submission must be rejected (``gateway.rejected``), the
+    worker loss must leave exactly ONE schema-valid flight-recorder
+    dump naming the lost worker, and a journal reopen must replay the
+    retired set (``gateway.journal_replays``).  Returns failure
+    strings."""
+    import shutil
+
+    import numpy as np
+
+    from dccrg_tpu import obs
+    from dccrg_tpu.obs import flight_recorder, validate_flightrec
+    from dccrg_tpu.serve import (
+        Ensemble,
+        Gateway,
+        SubmissionJournal,
+        WorkerHandle,
+    )
+    from dccrg_tpu.serve.worker import build_scenario
+
+    failures: list = []
+
+    def total(name: str) -> int:
+        rep = obs.metrics.report()
+        return int(sum(rep["counters"].get(name, {}).values()))
+
+    watched = ("gateway.accepted", "gateway.rejected",
+               "gateway.redispatched", "gateway.worker_lost",
+               "gateway.retired", "gateway.journal_replays")
+    before = {n: total(n) for n in watched}
+    prev_dir = flight_recorder.armed_dir
+    td = tempfile.mkdtemp(prefix="dccrg_fleet_probe_")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("DCCRG_GATEWAY_QUEUE_MAX",
+                           "DCCRG_GATEWAY_STALL_S",
+                           "DCCRG_COMPILE_CACHE_DIR")}
+    gw = None
+    try:
+        fr_dir = os.path.join(td, "flightrec")
+        os.makedirs(fr_dir)
+        flight_recorder.arm(fr_dir, autodump=False)
+        # worker cold start (jax import + first compile) exceeds the
+        # 10 s default stall budget; the kill below is the ONLY loss
+        # this probe scripts, so spurious stall escalations must not
+        # race it
+        os.environ["DCCRG_GATEWAY_STALL_S"] = "120"
+        os.environ["DCCRG_GATEWAY_QUEUE_MAX"] = "4"
+        os.environ["DCCRG_COMPILE_CACHE_DIR"] = os.path.join(td, "cache")
+        workers = [WorkerHandle(w, os.path.join(td, w), n_devices=4)
+                   for w in ("w0", "w1")]
+        for w in workers:
+            w.start()
+        gw = Gateway(os.path.join(td, "journal.jsonl"), workers)
+        specs = [{"sid": f"fp{i}", "model": "gol", "n": 8, "seed": i,
+                  "steps": 24, "tenant": "fleet"} for i in range(4)]
+        for s in specs:
+            ok, why = gw.submit(dict(s))
+            if not ok:
+                failures.append(
+                    f"fleet probe: {s['sid']} rejected ({why})")
+        ok, why = gw.submit({"sid": "fp-overflow", "model": "gol",
+                             "steps": 1, "tenant": "fleet"})
+        if ok or why != "queue-full":
+            failures.append(
+                "fleet probe: overflow submission past the pinned "
+                f"queue bound was not rejected (got {(ok, why)!r})")
+        gw.tick(restart_lost=False)
+        victim = "w0" if gw.journal.in_flight("w0") else "w1"
+        survivor = "w1" if victim == "w0" else "w0"
+        victim_sids = set(gw.journal.in_flight(victim))
+        if not victim_sids:
+            failures.append(
+                "fleet probe: no in-flight work assigned to the victim")
+        # wait until the victim reports 'started' (it is genuinely
+        # stepping, not just assigned), then SIGKILL it mid-flight
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            gw.tick(restart_lost=False)
+            if any(gw.journal.accepted[s].get("sig")
+                   for s in victim_sids):
+                break
+            time.sleep(0.2)
+        else:
+            failures.append(
+                "fleet probe: victim never reported 'started' in 180s")
+        victim_sids = set(gw.journal.in_flight(victim))
+        gw.workers[victim].kill()
+        if not gw.run_until_drained(timeout_s=300.0, restart_lost=False):
+            failures.append(
+                "fleet probe: fleet failed to drain within 300s after "
+                "the forced worker kill")
+        # exact retire counts: every accepted scenario exactly once
+        accepted = set(gw.journal.accepted)
+        if set(gw.journal.retired) != accepted:
+            failures.append(
+                f"fleet probe: retired {sorted(gw.journal.retired)} != "
+                f"accepted {sorted(accepted)}")
+        d_retired = total("gateway.retired") - before["gateway.retired"]
+        if d_retired != len(specs):
+            failures.append(
+                f"fleet probe: {d_retired} retirements counted, wanted "
+                f"exactly {len(specs)} (at-least-once stepping must "
+                "stay exactly-once retirement)")
+        if total("gateway.worker_lost") - before["gateway.worker_lost"] \
+                != 1:
+            failures.append(
+                "fleet probe: the one forced kill did not count as "
+                "exactly one gateway.worker_lost")
+        d_re = (total("gateway.redispatched")
+                - before["gateway.redispatched"])
+        if d_re != len(victim_sids):
+            failures.append(
+                f"fleet probe: {d_re} redispatches counted, wanted "
+                f"{len(victim_sids)} (the victim's in-flight set)")
+        if total("gateway.accepted") - before["gateway.accepted"] \
+                != len(specs):
+            failures.append(
+                "fleet probe: accepted count does not match the "
+                "submitted fleet")
+        # bit-identity: one redispatched member vs uninterrupted solo
+        if victim_sids and not failures:
+            sid = sorted(victim_sids)[0]
+            res = os.path.join(gw.workers[survivor].workdir,
+                               f"result_{sid}.npz")
+            spec = next(s for s in specs if s["sid"] == sid)
+            bundle = build_scenario(spec, n_devices=4)
+            ens = Ensemble()
+            t = ens.submit(bundle["model"], bundle["state"],
+                           steps=int(spec["steps"]), dt=bundle["dt"])
+            ens.run()
+            want = np.sort(np.asarray(
+                bundle["model"].alive_cells(t.result)))
+            try:
+                with np.load(res) as z:
+                    got = np.asarray(z["alive"])
+                if not np.array_equal(want, got):
+                    failures.append(
+                        f"fleet probe: redispatched member {sid} is not "
+                        "bit-identical to uninterrupted solo stepping")
+            except OSError as e:
+                failures.append(
+                    f"fleet probe: result park for {sid} unreadable: {e}")
+        # one postmortem per incident, naming the lost worker
+        dumps = sorted(p for p in os.listdir(fr_dir)
+                       if p.startswith("flightrec_")
+                       and p.endswith(".json"))
+        if len(dumps) != 1:
+            failures.append(
+                f"fleet probe: worker loss left {len(dumps)} "
+                f"flight-recorder dumps ({dumps}), wanted exactly one")
+        for p in dumps:
+            full = os.path.join(fr_dir, p)
+            failures += [f"fleet flightrec {p}: {f}"
+                         for f in validate_flightrec(full)]
+            with open(full) as f:
+                rec = json.load(f)
+            named = any(ev.get("kind") == "worker.lost"
+                        and ev.get("worker") == victim
+                        for ev in rec.get("events", []))
+            if not named:
+                failures.append(
+                    f"fleet probe: postmortem {p} does not name the "
+                    f"lost worker {victim}")
+        # crash durability: a journal reopen replays the retired set
+        j2 = SubmissionJournal(gw.journal.path)
+        if set(j2.retired) != accepted:
+            failures.append(
+                "fleet probe: journal reopen lost the retired set")
+        j2.close()
+        if (total("gateway.journal_replays")
+                - before["gateway.journal_replays"]) < 1:
+            failures.append(
+                "fleet probe: journal reopen did not count a replay")
+    except Exception as e:  # noqa: BLE001 — probe reports, not dies
+        failures.append(f"fleet probe failed: {e!r}")
+    finally:
+        if gw is not None:
+            gw.close()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if prev_dir is not None:
+            flight_recorder.arm(prev_dir)
+        else:
+            flight_recorder.disarm()
+        shutil.rmtree(td, ignore_errors=True)
+    return failures
+
+
 def _cost_probe() -> list:
     """Cost & capacity round (ISSUE 17).
 
@@ -1696,6 +1917,7 @@ def run_check(out_path: str, steps: int = 20, skip_overhead: bool = False,
     # DCCRG_COST_MODEL defaults on, asserted inside the probe)
     failures += _cost_probe()
     failures += _elastic_probe(g, state)
+    failures += _fleet_probe()
     failures += _device_timeline_probe(
         g, adv, state, dt, out_path,
         merged_path=artifact_path(out_path, ".merged_trace.json",

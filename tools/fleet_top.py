@@ -14,6 +14,7 @@ windowed snapshot — or refreshes in place with ``--follow``::
     python tools/fleet_top.py run/ --prometheus fleet.prom
     python tools/fleet_top.py run/ --alerts            # rule states too
     python tools/fleet_top.py run/ --cost              # cost & capacity
+    python tools/fleet_top.py run/ --workers           # gateway fleet view
 
 Every snapshot leads with a per-writer table including each stream's
 staleness (``age_s`` — seconds since its last snapshot): a silent dead
@@ -151,8 +152,81 @@ def print_snapshot(snap: dict, qs, alerts=None) -> None:
             print(f"{name:28s} {st['status']:8s} "
                   f"{'n/a' if v is None else f'{v:12.4g}'} "
                   f"{st['fires']:>6d}")
+    if snap.get("workers") is not None:
+        print_workers(snap["workers"])
     if snap.get("cost") is not None:
         print_cost(snap["cost"])
+
+
+def workers_section(view) -> dict:
+    """The ``--workers`` snapshot section (ISSUE 19): per-worker
+    liveness from each ``worker.stream.jsonl`` heartbeat's staleness
+    (the same ``stream.age_s`` signal the shipped ``worker-lost``
+    alert rule fires on), assigned/in-flight counts from the gateway's
+    ``gateway.assigned{worker}`` gauges, and redispatch events from
+    the ``gateway.redispatched{worker}`` counter."""
+    import os
+
+    try:
+        stall = float(os.environ.get("DCCRG_GATEWAY_STALL_S", "10"))
+    except ValueError:
+        stall = 10.0
+    cum = view.cumulative_report
+    gauges = cum.get("gauges") or {}
+    counters = cum.get("counters") or {}
+    workers: dict = {}
+
+    def row(wid: str) -> dict:
+        return workers.setdefault(wid, {
+            "age_s": None, "alive": None, "seq": None, "torn": 0,
+            "assigned": 0, "redispatched_from": 0})
+
+    for f in view.files:
+        p = pathlib.Path(f["path"])
+        if "worker" not in p.name:
+            continue
+        r = row(p.parent.name or p.stem)
+        r["age_s"] = f["age_s"]
+        r["alive"] = f["age_s"] <= stall
+        r["seq"] = f.get("seq")
+        r["torn"] = f.get("torn_tails", 0)
+    for label, v in (gauges.get("gateway.assigned") or {}).items():
+        wid = _labels_dict(label).get("worker")
+        if wid:
+            row(wid)["assigned"] = int(v)
+    for label, v in (counters.get("gateway.redispatched") or {}).items():
+        wid = _labels_dict(label).get("worker")
+        if wid:
+            row(wid)["redispatched_from"] = int(v)
+    return {
+        "workers": workers,
+        "redispatch_total": int(sum(
+            (counters.get("gateway.redispatched") or {}).values())),
+        "worker_lost_total": int(sum(
+            (counters.get("gateway.worker_lost") or {}).values())),
+        "backlog": (gauges.get("gateway.backlog") or {}).get("", None),
+    }
+
+
+def print_workers(w: dict) -> None:
+    print()
+    print(f"workers  redispatches={w['redispatch_total']}  "
+          f"lost={w['worker_lost_total']}  "
+          f"backlog={'n/a' if w.get('backlog') is None else w['backlog']}")
+    rows = w.get("workers") or {}
+    if not rows:
+        print("  (no worker streams found)")
+        return
+    print(f"{'worker':16s} {'live':>5s} {'age_s':>8s} {'seq':>8s} "
+          f"{'assigned':>9s} {'redisp_from':>12s}")
+    for wid, r in sorted(rows.items()):
+        age = r.get("age_s")
+        alive = r.get("alive")
+        print(f"{wid:16s} "
+              f"{'n/a' if alive is None else ('yes' if alive else 'NO'):>5s} "
+              f"{'n/a' if age is None else f'{age:8.1f}':>8s} "
+              f"{'n/a' if r.get('seq') is None else r['seq']:>8} "
+              f"{r['assigned']:>9d} {r['redispatched_from']:>12d}")
 
 
 def cost_section(view, cost_mod) -> dict:
@@ -230,6 +304,10 @@ def main(argv=None) -> int:
                     help="add the cost & capacity section: step-cost "
                          "model, chargeback ledger + conservation, "
                          "predicted queue-waits")
+    ap.add_argument("--workers", action="store_true",
+                    help="add the gateway fleet section: per-worker "
+                         "liveness (heartbeat staleness), assigned "
+                         "counts and redispatch events")
     ap.add_argument("--follow", action="store_true",
                     help="refresh in place every --refresh seconds")
     ap.add_argument("--refresh", type=float, default=2.0,
@@ -273,6 +351,8 @@ def main(argv=None) -> int:
             snap["alerts"] = alert_states
         if cost_mod is not None:
             snap["cost"] = cost_section(view, cost_mod)
+        if args.workers:
+            snap["workers"] = workers_section(view)
         if args.prometheus:
             text = live.to_prometheus(view.window_report)
             if args.prometheus == "-":

@@ -1650,6 +1650,598 @@ def run_elastic(lo: int, hi: int, stream_dir: str | None = None,
     return ok_all
 
 
+#: the fleet soak's solo-replay oracle: computes every scenario's
+#: uninterrupted single-member reference result (the bytes the fleet —
+#: kills, redispatches and all — must reproduce), then pre-compiles the
+#: cohort widths a 2-worker fleet can reach into the shared persistent
+#: cache (redispatch piles members onto survivors, so replacement
+#: cohorts are WIDER than the solo pass — warming widths 2 and 4 now is
+#: what makes ``epoch.recompiles == 0`` across the whole fleet a
+#: deterministic assertion, not a scheduling accident)
+FLEET_SOLO_CHILD = r"""import sys
+sys.path.insert(0, __DCCRG_ROOT__)
+import json
+import os
+
+specs_path, refdir, n_devices = sys.argv[2], sys.argv[3], int(sys.argv[4])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from dccrg_tpu.serve.ensemble import Ensemble
+from dccrg_tpu.serve.worker import build_scenario, park_state
+
+with open(specs_path) as f:
+    specs = json.load(f)
+os.makedirs(refdir, exist_ok=True)
+ens = Ensemble()
+# 1. the oracle: one member at a time, no chunking, no cohort peers
+for spec in specs:
+    b = build_scenario(spec, n_devices)
+    t = ens.submit(b["model"], b["state"], steps=int(spec["steps"]),
+                   dt=b["dt"])
+    ens.run()
+    park_state(b, t.result,
+               os.path.join(refdir, "result_%s.npz" % spec["sid"]),
+               int(spec["steps"]))
+# 2. warm the wider cohort bodies into the shared persistent cache
+widths = {"gol": (2, 4), "advection": (2,)}
+for kind, ws in widths.items():
+    ks = [s for s in specs if s.get("model", "gol") == kind]
+    if not ks:
+        continue
+    for width in ws:
+        for i in range(width):
+            b = build_scenario(ks[i % len(ks)], n_devices)
+            ens.submit(b["model"], b["state"], steps=4, dt=b["dt"])
+        ens.run()
+print("SOLO REFS OK", len(specs))
+"""
+
+
+#: one killable gateway incarnation: real worker subprocesses, a real
+#: journal, seeded mid-run worker SIGKILLs.  The parent SIGKILLs the
+#: whole incarnation once real progress is journaled and launches a
+#: second one over the SAME journal — durability is proven by the
+#: second incarnation replaying the first's watermarks and finishing
+#: the fleet to the oracle's bytes
+FLEET_GATEWAY_CHILD = r"""import sys
+sys.path.insert(0, __DCCRG_ROOT__)
+import json
+import os
+import random
+import time
+
+wd, specs_path = sys.argv[1], sys.argv[2]
+n_workers, n_devices = int(sys.argv[3]), int(sys.argv[4])
+seed, n_kills = int(sys.argv[5]), int(sys.argv[6])
+done_path = sys.argv[7]
+
+from dccrg_tpu import obs
+from dccrg_tpu.obs.flightrec import recorder as flightrec
+from dccrg_tpu.obs.registry import metrics
+from dccrg_tpu.serve import Gateway, WorkerHandle
+
+metrics.enabled = True
+obs.stream_to(os.path.join(wd, "gateway.stream.jsonl"), period=1.0,
+              truncate=True, extra={"role": "gateway"})
+flightrec.arm(wd, period=1.0)
+
+workers = [WorkerHandle("w%d" % i, os.path.join(wd, "w%d" % i), n_devices)
+           for i in range(n_workers)]
+for w in workers:
+    w.start()
+gw = Gateway(os.path.join(wd, "journal.jsonl"), workers)
+with open(specs_path) as f:
+    for spec in json.load(f):
+        ok, why = gw.submit(spec)   # idempotent across incarnations
+        if not ok:
+            print("REJECTED", spec["sid"], why, flush=True)
+
+rng = random.Random(seed * 7919 + n_kills)
+kills, last_kill_tick, ticks = 0, -10**9, 0
+deadline = time.monotonic() + 540.0
+while True:
+    st = gw.tick(restart_lost=True)
+    ticks += 1
+    if ticks % 40 == 0:
+        gw.journal.checkpoint()
+    # kill only after THIS incarnation has seen live watermark progress
+    # (gw._last_wm is incarnation-local), and only a victim with > 2
+    # chunks of work left — the redispatch must move real work, and the
+    # scenario must not retire in the race between kill and detection
+    if kills < n_kills and ticks - last_kill_tick > 60 and gw._last_wm:
+        def _meaty(w):
+            if w.lost or not w.alive():
+                return False
+            for sid in gw.journal.in_flight(w.wid):
+                done = gw.journal.watermark.get(sid, {}).get("step", 0)
+                if int(gw.journal.accepted[sid].get("steps", 0)) - done > 8:
+                    return True
+            return False
+        victims = sorted((w for w in workers if _meaty(w)),
+                         key=lambda w: w.wid)
+        if victims:
+            v = rng.choice(victims)
+            print("KILLING", v.wid, "generation", v.generation, flush=True)
+            v.kill()   # SIGKILL: next tick detects, redispatches, restarts
+            kills += 1
+            last_kill_tick = ticks
+    if st["outstanding"] == 0:
+        break
+    if time.monotonic() > deadline:
+        print("FLEET GATEWAY TIMEOUT", st, flush=True)
+        gw.close()
+        sys.exit(3)
+    time.sleep(0.05)
+gw.journal.checkpoint()
+rep = metrics.report()["counters"]
+state = {
+    "accepted": sorted(gw.journal.accepted),
+    "retired": sorted(gw.journal.retired),
+    "rejected": gw.journal.rejected,
+    "kills": kills,
+    "generations": {w.wid: w.generation for w in workers},
+    "redispatches": gw.redispatches,
+    "counters": {k: v for k, v in rep.items() if k.startswith("gateway.")},
+}
+tmp = done_path + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(state, f, sort_keys=True, indent=1)
+os.replace(tmp, done_path)
+gw.drain(timeout_s=30.0)   # SIGTERM drain: final heartbeats flush
+gw.close()
+print("FLEET DRAINED", len(state["retired"]), "retired", flush=True)
+"""
+
+
+def _fleet_specs(seed: int) -> list:
+    """The per-seed fleet workload: mixed signatures so routing
+    affinity and redispatch both cross model boundaries."""
+    specs = [{"sid": f"g{i}", "model": "gol", "n": 8,
+              "seed": seed * 100 + i, "steps": 48, "tenant": "fleet"}
+             for i in range(4)]
+    specs += [{"sid": f"a{i}", "model": "advection", "n": 4,
+               "seed": seed * 100 + 50 + i, "steps": 48,
+               "tenant": "fleet"} for i in range(2)]
+    return specs
+
+
+def _fleet_admission_ab(record, n_devices: int = 4) -> bool:
+    """The enforced-admission starvation A/B (ISSUE 19): with the
+    policy ON a burst tenant whose predicted queue wait blows its
+    budget is rejected at the door, so the deadline tenant's miss rate
+    stays zero; with ``DCCRG_GATEWAY_ADMISSION=0`` the same burst is
+    admitted, the deadline tenant queues behind one enormous chunk
+    round, and its deadline verdict flips to a miss.  Runs one real
+    worker in each mode; both runs warm the service-rate window (and
+    the shared compile cache) first so the prediction prices stepping,
+    not compiles."""
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from dccrg_tpu.obs.registry import metrics
+    from dccrg_tpu.serve import Gateway, WorkerHandle
+
+    tmp = tempfile.mkdtemp(prefix="dccrg_fleet_ab_")
+    chunk = 20000            # one OFF-mode burst round: minutes of steps
+    burst_steps = 2 * chunk
+    dl_deadline, burst_deadline = 5.0, 2.0
+    keys = ("DCCRG_GATEWAY_ADMISSION", "DCCRG_GATEWAY_PARK_EVERY",
+            "DCCRG_GATEWAY_STALL_S", "DCCRG_GATEWAY_QUEUE_MAX",
+            "DCCRG_SLO_QUEUE_S", "DCCRG_COMPILE_CACHE_DIR")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ["DCCRG_GATEWAY_PARK_EVERY"] = str(chunk)
+    os.environ["DCCRG_GATEWAY_STALL_S"] = "600"
+    os.environ["DCCRG_GATEWAY_QUEUE_MAX"] = "64"
+    os.environ.pop("DCCRG_SLO_QUEUE_S", None)
+    os.environ["DCCRG_COMPILE_CACHE_DIR"] = os.path.join(tmp, "cache")
+    metrics.enabled = True
+
+    def tenant_count(name, tenant):
+        rep = metrics.report()["counters"].get(name, {})
+        return sum(v for k, v in rep.items() if k == f"tenant={tenant}")
+
+    def one_run(tag, admission):
+        os.environ["DCCRG_GATEWAY_ADMISSION"] = "1" if admission else "0"
+        wd = os.path.join(tmp, tag)
+        w = WorkerHandle("w0", os.path.join(wd, "w0"), n_devices)
+        w.start()
+        gw = Gateway(os.path.join(wd, "journal.jsonl"), [w])
+
+        def drive(pending, budget_s):
+            deadline = time.monotonic() + budget_s
+            while set(pending) - gw.journal.retired:
+                gw.tick()
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(0.05)
+            return True
+
+        try:
+            # arm both tenants' service rates on real retirements; the
+            # dl warmup is long enough that its measured rate reflects
+            # stepping throughput, not the one-off compile wall
+            gw.submit({"sid": "warm-b", "model": "advection", "n": 4,
+                       "seed": 7, "steps": 4000, "tenant": "burst"})
+            gw.submit({"sid": "warm-d", "model": "gol", "n": 8,
+                       "seed": 7, "steps": 2000, "tenant": "dl"})
+            if not drive(["warm-b", "warm-d"], 420.0):
+                print("fleet A/B: warmup never retired")
+                return None
+            rejected = 0
+            for i in range(4):
+                ok, _ = gw.submit({"sid": f"b{i}", "model": "advection",
+                                   "n": 4, "seed": 100 + i,
+                                   "steps": burst_steps, "tenant": "burst",
+                                   "deadline_s": burst_deadline})
+                rejected += 0 if ok else 1
+            ok, why = gw.submit({"sid": "dl0", "model": "gol", "n": 8,
+                                 "seed": 9, "steps": 8, "tenant": "dl",
+                                 "deadline_s": dl_deadline})
+            if not ok:
+                print(f"fleet A/B ({tag}): deadline tenant rejected "
+                      f"({why}) — it must always be admitted")
+                return None
+            miss0 = tenant_count("gateway.deadline_miss", "dl")
+            ok0 = tenant_count("gateway.deadline_ok", "dl")
+            if not drive(["dl0"], 420.0):
+                print(f"fleet A/B ({tag}): deadline tenant never retired")
+                return None
+            return {
+                "rejected": rejected,
+                "miss": tenant_count("gateway.deadline_miss", "dl") - miss0,
+                "ok": tenant_count("gateway.deadline_ok", "dl") - ok0,
+            }
+        finally:
+            gw.close()   # abandoned burst members die with the worker
+
+    try:
+        on = one_run("on", True)
+        off = one_run("off", False)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    record(phase="admission-ab", on=on, off=off)
+    ok_all = True
+    if on is None or off is None:
+        print("fleet A/B: a mode failed to complete")
+        return False
+    if on["rejected"] < 1:
+        print(f"fleet A/B: policy ON admitted the whole burst "
+              f"({on}) — admission is not enforcing")
+        ok_all = False
+    if on["miss"] != 0 or on["ok"] != 1:
+        print(f"fleet A/B: deadline tenant missed under policy ON "
+              f"({on}) — the burst starved it despite admission")
+        ok_all = False
+    if off["rejected"] != 0:
+        print(f"fleet A/B: DCCRG_GATEWAY_ADMISSION=0 rejected "
+              f"submissions ({off}) — the A/B baseline is not off")
+        ok_all = False
+    if off["miss"] < 1:
+        print(f"fleet A/B: deadline tenant met its deadline under the "
+              f"unthrottled burst ({off}) — starvation did not "
+              "reproduce; the A/B proves nothing")
+        ok_all = False
+    print(f"fleet A/B: ON rejected={on['rejected']} dl_miss={on['miss']}"
+          f" | OFF rejected={off['rejected']} dl_miss={off['miss']}")
+    return ok_all
+
+
+def run_fleet(lo: int, hi: int, stream_dir: str | None = None,
+              n_workers: int = 2, n_devices: int = 4) -> bool:
+    """The fault-tolerant fleet gateway proof harness (ISSUE 19).
+    Per seed:
+
+    1. a solo-replay oracle child computes every scenario's
+       uninterrupted reference bytes and pre-warms the shared compile
+       cache across cohort widths;
+    2. a gateway child runs N supervised workers over a crash-durable
+       journal; it SIGKILLs one worker mid-flight (seeded), and the
+       PARENT SIGKILLs the whole gateway once real progress is
+       journaled — then relaunches it over the same journal, where a
+       second seeded worker kill lands during the replayed run;
+    3. every accepted scenario must retire EXACTLY once (journal
+       dedupe across kills, zombies and both incarnations), and every
+       result — including redispatched members — must match the oracle
+       (GoL bit-exact, advection to the 1e-11 cross-layout tolerance);
+    4. the loss postmortem: a schema-valid flight-recorder dump naming
+       the killed worker; replacements must be WARM
+       (``epoch.recompiles == 0`` in every worker's final stream);
+    5. the fleet p99 comes from merging the per-worker histogram
+       exports (``obs.slo.merge_series`` over the worker streams).
+
+    After the seed loop, one enforced-admission starvation A/B
+    (:func:`_fleet_admission_ab`)."""
+    import glob as _glob
+    import json
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    from dccrg_tpu.obs import slo as obs_slo
+    from dccrg_tpu.obs.flightrec import validate_flightrec
+    from dccrg_tpu.obs.stream import TelemetryStream
+
+    stream = None
+    if stream_dir:
+        os.makedirs(stream_dir, exist_ok=True)
+        stream = TelemetryStream(
+            os.path.join(stream_dir, f"fleet_{lo}_{hi}.jsonl"),
+            truncate=True,
+            extra={"subsystem": "fleet", "seeds": [lo, hi]},
+        )
+
+    def record(**kw):
+        if stream is not None:
+            stream.write_snapshot(**kw)
+
+    def launch(body, argv, env_extra=None, log_name="child.log"):
+        env = dict(os.environ)
+        env.pop("DCCRG_FAULT", None)
+        env.update(env_extra or {})
+        log = open(os.path.join(argv[0], log_name), "a")
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             body.replace("__DCCRG_ROOT__", repr(str(ROOT)))]
+            + [str(a) for a in argv],
+            cwd=str(ROOT), stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        return p, log
+
+    def wait_for(p, timeout):
+        t0 = time.monotonic()
+        while p.poll() is None:
+            if time.monotonic() - t0 > timeout:
+                p.kill()
+                p.wait()
+                return None
+            time.sleep(0.25)
+        return p.returncode
+
+    ok_all = True
+    for seed in range(lo, hi):
+        tmp = tempfile.mkdtemp(prefix=f"dccrg_fleet_{seed}_")
+        try:
+            specs = _fleet_specs(seed)
+            sids = [s["sid"] for s in specs]
+            specs_path = os.path.join(tmp, "specs.json")
+            with open(specs_path, "w") as f:
+                json.dump(specs, f)
+            env = {
+                "DCCRG_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+                "XLA_FLAGS":
+                    f"--xla_force_host_platform_device_count={n_devices}",
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "DCCRG_GATEWAY_PARK_EVERY": "4",
+                "DCCRG_GATEWAY_STALL_S": "120",
+                "DCCRG_GATEWAY_QUEUE_MAX": "64",
+                "DCCRG_GATEWAY_ADMISSION": "1",
+                "DCCRG_SLO_QUEUE_S": "",   # falsy: no ambient budget
+            }
+
+            # 1. the solo-replay oracle (+ cohort-width cache warmer)
+            refdir = os.path.join(tmp, "ref")
+            os.makedirs(refdir)
+            p, log = launch(FLEET_SOLO_CHILD,
+                            [tmp, specs_path, refdir, n_devices],
+                            env, log_name="solo.log")
+            rc = wait_for(p, 420.0)
+            log.close()
+            if rc != 0:
+                print(f"fleet seed {seed}: solo oracle failed rc={rc}")
+                print(open(os.path.join(tmp, "solo.log")).read()[-2000:])
+                record(seed=seed, outcome="oracle-failed", exit=rc)
+                ok_all = False
+                continue
+
+            # 2a. gateway incarnation 0: one seeded worker SIGKILL; the
+            #     parent SIGKILLs the incarnation once a watermark is
+            #     journaled (fsync'd appends make the cut byte-exact)
+            wd = os.path.join(tmp, "fleet")
+            os.makedirs(wd)
+            done_path = os.path.join(wd, "done.json")
+            p, log = launch(
+                FLEET_GATEWAY_CHILD,
+                [wd, specs_path, n_workers, n_devices, seed, 1,
+                 done_path],
+                env, log_name="gateway_0.log")
+            journal = os.path.join(wd, "journal.jsonl")
+            snap = journal + ".snap.json"
+            def journaled_progress():
+                """True once a real watermark is durable — in the WAL
+                (record form) or compacted into the snapshot state."""
+                try:
+                    with open(journal, "rb") as f:
+                        if b'"ev":"watermark"' in f.read():
+                            return True
+                except OSError:
+                    pass
+                try:
+                    with open(snap) as f:
+                        state = (json.load(f).get("state") or {})
+                    return bool(state.get("watermark"))
+                except (OSError, ValueError):
+                    return False
+
+            killed_gw = False
+            t0 = time.monotonic()
+            while p.poll() is None and time.monotonic() - t0 < 300.0:
+                if journaled_progress():
+                    time.sleep(0.2 + (seed % 5) * 0.3)
+                    p.kill()
+                    p.wait()
+                    killed_gw = True
+                    break
+                time.sleep(0.25)
+            log.close()
+            record(seed=seed, phase="gateway-sigkill", killed=killed_gw)
+            if not killed_gw:
+                rc = wait_for(p, 60.0)
+                print(f"fleet seed {seed}: no watermark journaled in "
+                      f"300s (gateway rc={rc}) — nothing to replay")
+                print(open(os.path.join(
+                    wd, "gateway_0.log")).read()[-2000:])
+                record(seed=seed, outcome="no-progress", exit=rc)
+                ok_all = False
+                continue
+
+            # 2b. incarnation 1 over the SAME journal: replay, resume,
+            #     one more seeded worker kill, drain to completion
+            p, log = launch(
+                FLEET_GATEWAY_CHILD,
+                [wd, specs_path, n_workers, n_devices, seed + 1, 1,
+                 done_path],
+                env, log_name="gateway_1.log")
+            rc = wait_for(p, 600.0)
+            log.close()
+            if rc != 0:
+                print(f"fleet seed {seed}: relaunched gateway failed "
+                      f"rc={rc}")
+                print(open(os.path.join(
+                    wd, "gateway_1.log")).read()[-3000:])
+                record(seed=seed, outcome="relaunch-failed", exit=rc)
+                ok_all = False
+                continue
+            with open(done_path) as f:
+                done = json.load(f)
+
+            def ctr(name):
+                return sum((done["counters"].get(name) or {}).values())
+
+            fails = []
+            # 3a. exactly-once retirement across both incarnations
+            if set(done["accepted"]) != set(sids):
+                fails.append(f"accepted {done['accepted']} != "
+                             f"submitted {sids}")
+            if set(done["retired"]) != set(sids):
+                fails.append(f"retired {done['retired']} != "
+                             f"submitted {sids}")
+            if ctr("gateway.journal_replays") < 1:
+                fails.append("relaunched gateway never replayed the "
+                             "journal")
+            if ctr("gateway.worker_lost") < 1:
+                fails.append("incarnation 1's seeded kill counted no "
+                             "gateway.worker_lost")
+            if ctr("gateway.redispatched") < 1:
+                fails.append("worker loss moved no in-flight work "
+                             "(gateway.redispatched == 0)")
+            # 3b. every result (original, redispatched, zombie
+            #     duplicate) byte-compares against the oracle
+            for spec in specs:
+                sid = spec["sid"]
+                ref = os.path.join(refdir, f"result_{sid}.npz")
+                outs = sorted(_glob.glob(os.path.join(
+                    wd, "w*", f"result_{sid}.npz")))
+                if not outs:
+                    fails.append(f"{sid}: retired but no worker holds "
+                                 "its result park")
+                    continue
+                with np.load(ref) as z:
+                    want = {k: np.asarray(z[k]) for k in z.files}
+                for out in outs:
+                    with np.load(out) as z:
+                        got = {k: np.asarray(z[k]) for k in z.files}
+                    try:
+                        if spec["model"] == "gol":
+                            np.testing.assert_array_equal(
+                                got["alive"], want["alive"])
+                        else:
+                            for field in ("density", "vx", "vy", "vz"):
+                                np.testing.assert_allclose(
+                                    got[field], want[field],
+                                    rtol=1e-11, atol=0)
+                    except AssertionError as e:
+                        fails.append(f"{sid}: {os.path.basename(out)} "
+                                     f"diverged from the solo oracle: "
+                                     f"{str(e)[:200]}")
+            # 4a. the loss postmortem names a killed worker
+            dumps = _glob.glob(os.path.join(wd, "flightrec_*.json"))
+            named = False
+            for dump in dumps:
+                probs = validate_flightrec(dump)
+                if probs:
+                    fails.append(f"{os.path.basename(dump)}: {probs[0]}")
+                    continue
+                with open(dump) as f:
+                    rec = json.load(f)
+                named = named or any(
+                    ev.get("kind") == "worker.lost" and ev.get("worker")
+                    for ev in rec.get("events", []))
+            if not named:
+                fails.append("no flight-recorder dump names a lost "
+                             f"worker ({len(dumps)} dumps)")
+            # 4b. warm fleet: the oracle pre-warmed every cohort width,
+            #     so NO worker incarnation — replacements included —
+            #     may recompile; final streams are the evidence
+            reports = []
+            for wdir in sorted(_glob.glob(os.path.join(wd, "w*"))):
+                spath = os.path.join(wdir, "worker.stream.jsonl")
+                try:
+                    rep = obs_slo.load_report(spath)
+                except (OSError, ValueError):
+                    continue   # a worker that never snapshotted
+                reports.append(rep)
+                ctrs = rep.get("counters") or {}
+                recompiles = sum(
+                    (ctrs.get("epoch.recompiles") or {}).values())
+                warm = sum(
+                    (ctrs.get("epoch.warm_compiles") or {}).values())
+                if recompiles:
+                    fails.append(
+                        f"{os.path.basename(wdir)}: replacement NOT "
+                        f"warm: epoch.recompiles={recompiles} "
+                        f"(warm_compiles={warm})")
+            if max(done["generations"].values() or [0]) < 2:
+                fails.append("no worker was ever replaced (generations "
+                             f"{done['generations']})")
+            # 5. fleet p99 from the merged per-worker histogram exports
+            series = obs_slo.merge_series(reports, "ensemble.e2e_s")
+            merged = obs_slo.merge(*series.values())
+            p99 = obs_slo.quantile(merged, 0.99)
+            if p99 is None:
+                fails.append("merged worker streams yield no "
+                             "ensemble.e2e_s histogram — no fleet p99")
+            for msg in fails:
+                print(f"fleet seed {seed}: {msg}")
+            outcome = "ok" if not fails else "failed"
+            record(seed=seed, outcome=outcome, retired=len(done["retired"]),
+                   kills=done["kills"], generations=done["generations"],
+                   redispatches=len(done["redispatches"]),
+                   fleet_p99_s=p99, failures=fails)
+            if fails:
+                ok_all = False
+                continue
+            print(f"fleet seed {seed}: OK — {len(done['retired'])} "
+                  f"retired exactly once across a gateway SIGKILL and "
+                  f"{done['kills'] + 1} worker kills; fleet p99="
+                  f"{p99:.3f}s from {len(reports)} merged worker "
+                  "streams")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    ab_ok = _fleet_admission_ab(record)
+    ok_all = ok_all and ab_ok
+    if stream is not None:
+        stream.stop(final=True)
+    print(f"{'fleet':12s} [{lo},{hi}): {'OK' if ok_all else 'FAIL'}")
+    return ok_all
+
+
 #: prepended to every child body when streaming is on: appends an
 #: incremental registry snapshot as JSONL every few seconds (plus a
 #: final one at exit), so a hung or killed seed leaves the phase
@@ -1747,7 +2339,8 @@ def merge_fleet(stream_dir: str) -> str | None:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("subsystem",
-                    choices=list(BODIES) + ["crash", "elastic", "all"])
+                    choices=list(BODIES) + ["crash", "elastic", "fleet",
+                                            "all"])
     ap.add_argument("--seeds", type=int, nargs=2, default=(0, 10))
     ap.add_argument("--crash-seeds", type=int, nargs=2, default=None,
                     help="seed range for the crash subsystem under "
@@ -1767,6 +2360,8 @@ def main():
         results.append(run_crash(*a.seeds, stream_dir=sdir))
     elif a.subsystem == "elastic":
         results.append(run_elastic(*a.seeds, stream_dir=sdir))
+    elif a.subsystem == "fleet":
+        results.append(run_fleet(*a.seeds, stream_dir=sdir))
     else:
         results += [run(n, *a.seeds, stream_dir=sdir)
                     for n in names if n != "crash"]
@@ -1775,6 +2370,7 @@ def main():
                                        min(a.seeds[0] + 3, a.seeds[1]))
             results.append(run_crash(lo, hi, stream_dir=sdir))
             results.append(run_elastic(lo, hi, stream_dir=sdir))
+            results.append(run_fleet(lo, hi, stream_dir=sdir))
     if sdir:
         merge_fleet(sdir)
     sys.exit(0 if all(results) else 1)

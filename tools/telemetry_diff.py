@@ -78,6 +78,18 @@ GATED_COUNTERS = (
     # produces exactly its scripted misses; the cost probe submits no
     # deadlines), so any rise here is the clamp mispricing, not noise.
     "ensemble.deadline_miss",
+    # ISSUE 19: the fleet probe scripts its gateway workload exactly —
+    # 4 accepted scenarios, 1 pinned-queue rejection, one forced worker
+    # kill whose in-flight set redispatches, one journal reopen.  Every
+    # one of these counts is probe-pinned, so a round-over-round rise
+    # is a behavioral regression, not workload noise: extra accepts or
+    # rejects mean admission drifted, extra redispatches mean spurious
+    # worker losses (a stall-budget or heartbeat regression), extra
+    # replays mean journals started reopening when they shouldn't.
+    "gateway.accepted",
+    "gateway.rejected",
+    "gateway.redispatched",
+    "gateway.journal_replays",
 )
 
 #: counters REPORTED round-over-round but never failed (ISSUE 16): how
